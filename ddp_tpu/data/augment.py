@@ -1,0 +1,40 @@
+"""Vectorised CIFAR train-time augmentation.
+
+Reference transforms (singlegpu.py:154-160): RandomCrop(32, padding=4) +
+RandomHorizontalFlip + ToTensor.  torchvision applies them per-sample in
+Python; at batch 512 x N chips that becomes the input bottleneck the GPU
+reference never noticed (SURVEY.md section 7 hard-part #4), so here the whole
+batch is augmented with single vectorised numpy gathers on the host.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+PAD = 4
+SIZE = 32
+
+
+def random_crop_flip(batch: np.ndarray, rng: np.random.Generator
+                     ) -> np.ndarray:
+    """[N,32,32,3] uint8 -> augmented [N,32,32,3] uint8.
+
+    Zero-padding and uniform offsets match torchvision RandomCrop defaults
+    (fill=0); flip probability 0.5.
+    """
+    n = batch.shape[0]
+    padded = np.pad(batch, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
+    ys = rng.integers(0, 2 * PAD + 1, n)
+    xs = rng.integers(0, 2 * PAD + 1, n)
+    row = np.arange(SIZE)
+    out = padded[np.arange(n)[:, None, None],
+                 (ys[:, None] + row)[:, :, None],
+                 (xs[:, None] + row)[:, None, :], :]
+    flip = rng.random(n) < 0.5
+    out[flip] = out[flip, :, ::-1]
+    return out
+
+
+def to_float(batch_u8: np.ndarray) -> np.ndarray:
+    """ToTensor scaling: uint8 [0,255] -> float32 [0,1].  The reference
+    applies no mean/std normalisation (singlegpu.py:154-160)."""
+    return batch_u8.astype(np.float32) / 255.0

@@ -1,0 +1,27 @@
+"""Model registry — the TPU-native analogue of the reference's
+``load_train_objs`` model seam (multigpu.py:122-126), which makes the Trainer
+model-agnostic."""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+
+
+class ModelDef(NamedTuple):
+    name: str
+    init: Callable[..., Tuple[Dict, Dict]]
+    apply: Callable[..., Tuple[jax.Array, Dict]]
+
+
+def get_model(name: str) -> ModelDef:
+    if name == "vgg":
+        from . import vgg
+        return ModelDef("vgg", vgg.init, vgg.apply)
+    if name == "deepnn":
+        from . import deepnn
+        return ModelDef("deepnn", deepnn.init, deepnn.apply)
+    if name == "resnet18":
+        from . import resnet
+        return ModelDef("resnet18", resnet.init, resnet.apply)
+    raise ValueError(f"unknown model {name!r}; available: vgg, deepnn, resnet18")

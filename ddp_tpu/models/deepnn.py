@@ -1,0 +1,88 @@
+"""DeepNN — plain CNN from the reference (singlegpu.py:18-44).
+
+Dead code there (defined but never instantiated — SURVEY.md 2.5), implemented
+here anyway as part of the declared surface and as a second numerics fixture.
+1,186,986 params.
+
+Layout note: torch flattens NCHW ([N,32,8,8] -> channel-major 2048); we flatten
+NHWC ([N,8,8,32] -> spatial-major 2048).  ``utils.torch_interop`` permutes the
+first classifier weight accordingly, so forward numerics still match torch
+exactly.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import initializers as init_lib
+from ..ops.layers import conv2d, dropout, linear, max_pool
+
+NAME = "deepnn"
+NUM_CLASSES = 10
+DROPOUT_RATE = 0.1  # singlegpu.py:36
+
+# (in_ch, out_ch) of the four 3x3 convs; 'M' = maxpool2 (singlegpu.py:21-32)
+_FEATURES = [(3, 128), (128, 64), "M", (64, 64), (64, 32), "M"]
+
+Params = Dict[str, Any]
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Params, Dict]:
+    features: Dict[str, Any] = {}
+    idx = 0
+    for spec in _FEATURES:
+        if spec == "M":
+            continue
+        in_ch, out_ch = spec
+        key, wkey, bkey = jax.random.split(key, 3)
+        features[f"conv{idx}"] = {
+            "kernel": init_lib.conv_kernel(wkey, 3, 3, in_ch, out_ch, dtype),
+            "bias": init_lib.conv_bias(bkey, 3, 3, in_ch, out_ch, dtype),
+        }
+        idx += 1
+    key, w0, b0, w1, b1 = jax.random.split(key, 5)
+    params: Params = {
+        "features": features,
+        "classifier": {
+            "linear0": {"weight": init_lib.linear_weight(w0, 2048, 512, dtype),
+                        "bias": init_lib.linear_bias(b0, 2048, 512, dtype)},
+            "linear1": {"weight": init_lib.linear_weight(w1, 512, NUM_CLASSES,
+                                                         dtype),
+                        "bias": init_lib.linear_bias(b1, 512, NUM_CLASSES,
+                                                     dtype)},
+        },
+    }
+    return params, {}  # no batch-norm -> no running stats
+
+
+def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
+          rng: Optional[jax.Array] = None,
+          compute_dtype: Optional[jnp.dtype] = None,
+          ) -> Tuple[jax.Array, Dict]:
+    del batch_stats
+    cd = compute_dtype or x.dtype
+    x = x.astype(cd)
+    idx = 0
+    for spec in _FEATURES:
+        if spec == "M":
+            x = max_pool(x, 2, 2)
+            continue
+        conv = params["features"][f"conv{idx}"]
+        x = conv2d(x, conv["kernel"].astype(cd), conv["bias"].astype(cd),
+                   stride=1, padding=1)
+        x = jax.nn.relu(x)
+        idx += 1
+    x = x.reshape(x.shape[0], -1)  # [N,8,8,32] -> [N,2048] (NHWC order)
+    cls = params["classifier"]
+    x = linear(x, cls["linear0"]["weight"].astype(cd),
+               cls["linear0"]["bias"].astype(cd))
+    x = jax.nn.relu(x)
+    if train:
+        if rng is None:
+            raise ValueError("DeepNN needs an rng for dropout in train mode")
+        x = dropout(rng, x, DROPOUT_RATE, train=True)
+    logits = linear(x, cls["linear1"]["weight"].astype(cd),
+                    cls["linear1"]["bias"].astype(cd))
+    return logits.astype(jnp.float32), {}

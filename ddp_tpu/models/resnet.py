@@ -1,0 +1,129 @@
+"""ResNet-18, torchvision-compatible, for the "drop a real conv workload into
+the Trainer" config (BASELINE.json config #3; the reference's model seam is
+``load_train_objs``, multigpu.py:122-126).
+
+Architecture and init follow torchvision.models.resnet18 exactly (7x7/2 stem +
+3x3/2 maxpool, four stages of two BasicBlocks, kaiming-normal fan-out conv
+init, BN gamma=1 beta=0, linear default init) so the implementation is
+parity-testable against torch weights via
+``utils.torch_interop.resnet18_from_torch_state_dict``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import initializers as init_lib
+from ..ops.layers import (BatchNormState, batch_norm, conv2d, global_avg_pool,
+                          linear, max_pool)
+
+NAME = "resnet18"
+NUM_CLASSES = 10
+STAGES = [(64, 1), (128, 2), (256, 2), (512, 2)]  # (width, first-block stride)
+BLOCKS_PER_STAGE = 2
+
+
+def _kaiming_normal_fan_out(key, kh, kw, in_ch, out_ch, dtype=jnp.float32):
+    """torchvision conv init: kaiming_normal_(mode='fan_out',
+    nonlinearity='relu') -> N(0, sqrt(2/fan_out)), fan_out = out_ch*kh*kw."""
+    std = math.sqrt(2.0 / (out_ch * kh * kw))
+    return std * jax.random.normal(key, (kh, kw, in_ch, out_ch), dtype)
+
+
+def _bn_init(ch, dtype=jnp.float32):
+    scale, bias = init_lib.batch_norm_params(ch, dtype)
+    mean, var = init_lib.batch_norm_stats(ch, dtype)
+    return {"scale": scale, "bias": bias}, {"mean": mean, "var": var}
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict, Dict]:
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    key, k = jax.random.split(key)
+    params["conv1"] = {"kernel": _kaiming_normal_fan_out(k, 7, 7, 3, 64, dtype)}
+    params["bn1"], stats["bn1"] = _bn_init(64, dtype)
+
+    in_ch = 64
+    for si, (width, stride) in enumerate(STAGES, start=1):
+        for bi in range(BLOCKS_PER_STAGE):
+            blk_stride = stride if bi == 0 else 1
+            name = f"layer{si}.block{bi}"
+            blk: Dict[str, Any] = {}
+            bstats: Dict[str, Any] = {}
+            key, k1, k2, k3 = jax.random.split(key, 4)
+            blk["conv1"] = {"kernel": _kaiming_normal_fan_out(
+                k1, 3, 3, in_ch, width, dtype)}
+            blk["bn1"], bstats["bn1"] = _bn_init(width, dtype)
+            blk["conv2"] = {"kernel": _kaiming_normal_fan_out(
+                k2, 3, 3, width, width, dtype)}
+            blk["bn2"], bstats["bn2"] = _bn_init(width, dtype)
+            if blk_stride != 1 or in_ch != width:
+                blk["downsample"] = {"conv": {"kernel": _kaiming_normal_fan_out(
+                    k3, 1, 1, in_ch, width, dtype)}}
+                blk["downsample"]["bn"], bstats["downsample_bn"] = _bn_init(
+                    width, dtype)
+            params[name] = blk
+            stats[name] = bstats
+            in_ch = width
+
+    key, wk, bk = jax.random.split(key, 3)
+    params["fc"] = {
+        "weight": init_lib.linear_weight(wk, 512, NUM_CLASSES, dtype),
+        "bias": init_lib.linear_bias(bk, 512, NUM_CLASSES, dtype),
+    }
+    return params, stats
+
+
+def _bn_apply(p, st, x, train, new_stats, key_out):
+    y, new_st = batch_norm(x, p["scale"], p["bias"],
+                           BatchNormState(st["mean"], st["var"]), train=train)
+    new_stats[key_out] = {"mean": new_st.mean, "var": new_st.var}
+    return y
+
+
+def apply(params: Dict, batch_stats: Dict, x: jax.Array, *, train: bool,
+          rng: Optional[jax.Array] = None,
+          compute_dtype: Optional[jnp.dtype] = None,
+          ) -> Tuple[jax.Array, Dict]:
+    del rng
+    cd = compute_dtype or x.dtype
+    x = x.astype(cd)
+    new_stats: Dict[str, Any] = {}
+
+    x = conv2d(x, params["conv1"]["kernel"].astype(cd), stride=2, padding=3)
+    x = _bn_apply(params["bn1"], batch_stats["bn1"], x, train, new_stats, "bn1")
+    x = jax.nn.relu(x)
+    x = max_pool(x, window=3, stride=2, padding=1)
+
+    in_ch = 64
+    for si, (width, stride) in enumerate(STAGES, start=1):
+        for bi in range(BLOCKS_PER_STAGE):
+            blk_stride = stride if bi == 0 else 1
+            name = f"layer{si}.block{bi}"
+            blk, bst = params[name], batch_stats[name]
+            ns: Dict[str, Any] = {}
+            identity = x
+            y = conv2d(x, blk["conv1"]["kernel"].astype(cd),
+                       stride=blk_stride, padding=1)
+            y = _bn_apply(blk["bn1"], bst["bn1"], y, train, ns, "bn1")
+            y = jax.nn.relu(y)
+            y = conv2d(y, blk["conv2"]["kernel"].astype(cd),
+                       stride=1, padding=1)
+            y = _bn_apply(blk["bn2"], bst["bn2"], y, train, ns, "bn2")
+            if "downsample" in blk:
+                identity = conv2d(x, blk["downsample"]["conv"]["kernel"]
+                                  .astype(cd), stride=blk_stride, padding=0)
+                identity = _bn_apply(blk["downsample"]["bn"],
+                                     bst["downsample_bn"], identity, train,
+                                     ns, "downsample_bn")
+            x = jax.nn.relu(y + identity)
+            new_stats[name] = ns
+            in_ch = width
+
+    x = global_avg_pool(x)
+    logits = linear(x, params["fc"]["weight"].astype(cd),
+                    params["fc"]["bias"].astype(cd))
+    return logits.astype(jnp.float32), new_stats

@@ -1,0 +1,94 @@
+"""VGG-11-style CIFAR classifier — the reference's flagship model
+(singlegpu.py:47-82; multigpu.py:36-71).
+
+Same architecture string, layer naming (``conv0``/``bn0``/... from the
+``add()`` helper, singlegpu.py:56-58), and parameter count (9,228,362 params,
+35.20 MiB fp32 — SURVEY.md 2.4), expressed functionally over NHWC activations
+so XLA:TPU tiles the convolutions onto the MXU.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import initializers as init_lib
+from ..ops.layers import (BatchNormState, batch_norm, conv2d, global_avg_pool,
+                          linear, max_pool)
+
+NAME = "vgg"
+NUM_CLASSES = 10
+# Reference singlegpu.py:48
+ARCH = [64, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+
+Params = Dict[str, Any]
+BatchStats = Dict[str, Any]
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Params, BatchStats]:
+    """Build params + running stats with PyTorch-default init distributions."""
+    backbone: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    in_ch = 3
+    idx = 0
+    for x in ARCH:
+        if x == "M":
+            continue
+        key, wkey = jax.random.split(key)
+        # conv3x3, padding 1, bias=False (singlegpu.py:64)
+        backbone[f"conv{idx}"] = {
+            "kernel": init_lib.conv_kernel(wkey, 3, 3, in_ch, x, dtype)
+        }
+        scale, bias = init_lib.batch_norm_params(x, dtype)
+        backbone[f"bn{idx}"] = {"scale": scale, "bias": bias}
+        mean, var = init_lib.batch_norm_stats(x, dtype)
+        stats[f"bn{idx}"] = {"mean": mean, "var": var}
+        in_ch = x
+        idx += 1
+    key, wkey, bkey = jax.random.split(key, 3)
+    params: Params = {
+        "backbone": backbone,
+        "classifier": {
+            "weight": init_lib.linear_weight(wkey, 512, NUM_CLASSES, dtype),
+            "bias": init_lib.linear_bias(bkey, 512, NUM_CLASSES, dtype),
+        },
+    }
+    return params, stats
+
+
+def apply(params: Params, batch_stats: BatchStats, x: jax.Array, *,
+          train: bool, rng: Optional[jax.Array] = None,
+          compute_dtype: Optional[jnp.dtype] = None,
+          ) -> Tuple[jax.Array, BatchStats]:
+    """Forward pass: [N,32,32,3] -> [N,10] logits (reference singlegpu.py:75-82).
+
+    ``compute_dtype=jnp.bfloat16`` gives the mixed-precision variant
+    (BASELINE.json config #4): activations and matmul/conv inputs in bf16,
+    BN statistics and the loss in fp32, params stored fp32.
+    """
+    del rng  # VGG has no dropout
+    cd = compute_dtype or x.dtype
+    x = x.astype(cd)
+    new_stats: Dict[str, Any] = {}
+    backbone = params["backbone"]
+    in_idx = 0
+    for a in ARCH:
+        if a == "M":
+            x = max_pool(x, 2, 2)
+            continue
+        conv = backbone[f"conv{in_idx}"]
+        x = conv2d(x, conv["kernel"].astype(cd), stride=1, padding=1)
+        bn = backbone[f"bn{in_idx}"]
+        st = batch_stats[f"bn{in_idx}"]
+        x, new_st = batch_norm(
+            x, bn["scale"], bn["bias"],
+            BatchNormState(st["mean"], st["var"]), train=train)
+        new_stats[f"bn{in_idx}"] = {"mean": new_st.mean, "var": new_st.var}
+        x = jax.nn.relu(x)
+        in_idx += 1
+    # [N,2,2,512] -> [N,512] -> [N,10]
+    x = global_avg_pool(x)
+    cls = params["classifier"]
+    logits = linear(x, cls["weight"].astype(cd), cls["bias"].astype(cd))
+    return logits.astype(jnp.float32), new_stats

@@ -1,0 +1,68 @@
+"""Parameter initialisers matching PyTorch layer defaults.
+
+Loss-curve parity with the reference (SURVEY.md section 7, "hard parts" #1)
+requires the same init *distributions* as ``nn.Conv2d`` / ``nn.Linear`` /
+``nn.BatchNorm2d`` defaults, which the reference relies on implicitly
+(singlegpu.py:64, 73 construct the layers with no explicit init).
+
+PyTorch defaults:
+- Conv2d / Linear weight: ``kaiming_uniform_(a=sqrt(5))``.  With
+  gain = sqrt(2 / (1 + a^2)) = sqrt(1/3) and bound = sqrt(3) * gain /
+  sqrt(fan_in), this reduces exactly to U(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+- Conv2d / Linear bias: U(-1/sqrt(fan_in), +1/sqrt(fan_in)).
+- BatchNorm2d: weight (gamma) = 1, bias (beta) = 0, running_mean = 0,
+  running_var = 1.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def torch_default_uniform(key: jax.Array, shape, fan_in: int,
+                          dtype=jnp.float32) -> jax.Array:
+    """U(-1/sqrt(fan_in), +1/sqrt(fan_in)) — PyTorch conv/linear default."""
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def conv_kernel(key: jax.Array, kh: int, kw: int, in_ch: int, out_ch: int,
+                dtype=jnp.float32) -> jax.Array:
+    """HWIO conv kernel with the PyTorch Conv2d default distribution.
+
+    PyTorch stores OIHW; we store HWIO (the native layout for XLA:TPU's
+    NHWC convolutions).  fan_in = in_ch * kh * kw in both layouts.
+    """
+    return torch_default_uniform(key, (kh, kw, in_ch, out_ch),
+                                 fan_in=in_ch * kh * kw, dtype=dtype)
+
+
+def linear_weight(key: jax.Array, in_features: int, out_features: int,
+                  dtype=jnp.float32) -> jax.Array:
+    """[in, out] linear weight (JAX convention; torch stores [out, in])."""
+    return torch_default_uniform(key, (in_features, out_features),
+                                 fan_in=in_features, dtype=dtype)
+
+
+def linear_bias(key: jax.Array, in_features: int, out_features: int,
+                dtype=jnp.float32) -> jax.Array:
+    return torch_default_uniform(key, (out_features,), fan_in=in_features,
+                                 dtype=dtype)
+
+
+def conv_bias(key: jax.Array, kh: int, kw: int, in_ch: int, out_ch: int,
+              dtype=jnp.float32) -> jax.Array:
+    return torch_default_uniform(key, (out_ch,), fan_in=in_ch * kh * kw,
+                                 dtype=dtype)
+
+
+def batch_norm_params(num_features: int, dtype=jnp.float32):
+    """(scale, bias) = (1, 0) — BatchNorm2d affine defaults."""
+    return jnp.ones((num_features,), dtype), jnp.zeros((num_features,), dtype)
+
+
+def batch_norm_stats(num_features: int, dtype=jnp.float32):
+    """(running_mean, running_var) = (0, 1)."""
+    return jnp.zeros((num_features,), dtype), jnp.ones((num_features,), dtype)

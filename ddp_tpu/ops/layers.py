@@ -1,0 +1,111 @@
+"""Low-level NN ops in the TPU-native layout (NHWC activations, HWIO kernels).
+
+These are the building blocks for the models in ``ddp_tpu.models``; each op's
+numerics are tested for parity against the equivalent torch CPU op
+(tests/test_ops.py).  The reference gets these from torch.nn / cuDNN
+(singlegpu.py:64-73); on TPU we express them so XLA can tile the convolutions
+onto the MXU and fuse the elementwise BN/ReLU chains into them.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# NHWC / HWIO are the layouts XLA:TPU convolutions are natively tiled for.
+CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+
+def conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array] = None,
+           stride: int = 1, padding: int = 1) -> jax.Array:
+    """3x3-style 2-D convolution. x: [N,H,W,C_in], kernel: [kh,kw,C_in,C_out]."""
+    y = lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(stride, stride),
+        padding=((padding, padding), (padding, padding)),
+        dimension_numbers=CONV_DIMS,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def max_pool(x: jax.Array, window: int = 2, stride: int = 2,
+             padding: int = 0) -> jax.Array:
+    """MaxPool2d(window, stride, padding) — reference singlegpu.py:70 uses
+    (2, 2, 0); ResNet-18's stem uses (3, 2, 1)."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=((0, 0), (padding, padding), (padding, padding), (0, 0)),
+    )
+
+
+def linear(x: jax.Array, weight: jax.Array,
+           bias: Optional[jax.Array] = None) -> jax.Array:
+    """x @ weight (+ bias). weight: [in, out]."""
+    y = x @ weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def global_avg_pool(x: jax.Array) -> jax.Array:
+    """[N,H,W,C] -> [N,C] mean over spatial dims (reference x.mean([2,3]),
+    singlegpu.py:79)."""
+    return x.mean(axis=(1, 2))
+
+
+class BatchNormState(NamedTuple):
+    """Running statistics (the reference's BN buffers)."""
+    mean: jax.Array
+    var: jax.Array
+
+
+def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+               state: BatchNormState, *, train: bool,
+               momentum: float = 0.1, eps: float = 1e-5,
+               ) -> Tuple[jax.Array, BatchNormState]:
+    """BatchNorm2d with exact torch semantics.
+
+    Training normalises with the *biased* batch variance but updates the
+    running variance with the *unbiased* one (Bessel-corrected), momentum 0.1,
+    eps 1e-5 — the torch defaults the reference relies on (singlegpu.py:65).
+    Under data parallelism the batch statistics are per-replica: the reference
+    deliberately leaves SyncBatchNorm commented out (multigpu.py:127), and
+    shard_map gives the same per-shard semantics for free.
+
+    Statistics are accumulated in fp32 even when ``x`` is bf16 so the
+    mixed-precision path stays stable.
+    """
+    if train:
+        xf = x.astype(jnp.float32)
+        batch_mean = xf.mean(axis=(0, 1, 2))
+        batch_var = xf.var(axis=(0, 1, 2))  # biased (1/n), used to normalise
+        n = x.shape[0] * x.shape[1] * x.shape[2]
+        unbiased = batch_var * (n / max(n - 1, 1))
+        new_state = BatchNormState(
+            mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
+            var=(1.0 - momentum) * state.var + momentum * unbiased,
+        )
+        mean, var = batch_mean, batch_var
+    else:
+        new_state = state
+        mean, var = state.mean, state.var
+    inv = lax.rsqrt(var + eps) * scale
+    y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + bias.astype(x.dtype)
+    return y, new_state
+
+
+def dropout(key: jax.Array, x: jax.Array, rate: float,
+            train: bool) -> jax.Array:
+    """Inverted dropout (torch convention) — DeepNN uses rate 0.1
+    (singlegpu.py:36)."""
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

@@ -1,0 +1,39 @@
+"""Losses. The reference uses only ``F.cross_entropy`` with default mean
+reduction (singlegpu.py:105)."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_per_example(logits: jax.Array,
+                              labels: jax.Array) -> jax.Array:
+    """Per-example softmax cross-entropy, computed in fp32 for stability.
+
+    Matches ``F.cross_entropy(..., reduction='none')``.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return logz - picked
+
+
+def cross_entropy_sum_count(logits: jax.Array, labels: jax.Array,
+                            mask: Optional[jax.Array] = None,
+                            ) -> Tuple[jax.Array, jax.Array]:
+    """(sum of CE over valid examples, valid count).
+
+    The mean is taken as a *global* psum(sum)/psum(count) in the train step so
+    ragged final batches (padded+masked to keep XLA shapes static,
+    SURVEY.md section 7 hard-part #3) don't perturb the loss, and so the
+    distributed mean matches DDP's gradient averaging exactly (with torch's
+    ``DistributedSampler`` every rank has an equal count, making
+    mean-of-rank-means == global mean).
+    """
+    ce = cross_entropy_per_example(logits, labels)
+    if mask is None:
+        return ce.sum(), jnp.asarray(ce.shape[0], jnp.float32)
+    maskf = mask.astype(jnp.float32)
+    return (ce * maskf).sum(), maskf.sum()

@@ -1,0 +1,4 @@
+from .schedule import triangular_lr
+from .sgd import SGDConfig, SGDState, apply_updates, init
+
+__all__ = ["SGDConfig", "SGDState", "apply_updates", "init", "triangular_lr"]

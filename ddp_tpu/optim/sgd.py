@@ -1,0 +1,56 @@
+"""SGD with the exact PyTorch update convention the reference uses
+(singlegpu.py:135-140: lr=0.4, momentum=0.9, weight_decay=5e-4, applied to
+ALL params including BN scale/bias).
+
+PyTorch semantics (dampening=0, nesterov=False):
+    g   <- grad + weight_decay * param
+    buf <- momentum * buf + g          (buf starts at 0, so step 0 gives buf=g)
+    p   <- p - lr * buf
+
+Implemented directly (rather than via optax) so the torch weight-decay
+placement — decay folded into the gradient *before* the momentum trace, with
+no decoupling — is explicit and independently testable; the update rule is
+golden-tested against ``torch.optim.SGD`` per-step (tests/test_optim.py).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SGDConfig(NamedTuple):
+    """Hyperparameters (reference defaults, singlegpu.py:135-140).
+
+    ``lr`` is the *base* learning rate; the trainer passes it to the LR
+    schedule as ``base_lr`` and feeds the resulting effective per-step rate
+    to ``apply_updates`` as ``lr_t``.
+    """
+    lr: float = 0.4
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+
+
+class SGDState(NamedTuple):
+    momentum_buf: Any  # pytree matching params, zeros-initialised
+
+
+def init(params) -> SGDState:
+    return SGDState(jax.tree_util.tree_map(jnp.zeros_like, params))
+
+
+def apply_updates(params, grads, state: SGDState, lr_t,
+                  config: SGDConfig):
+    """One SGD step at effective learning rate ``lr_t`` (a scalar array so
+    the per-batch LR schedule doesn't trigger recompilation).
+
+    Returns (new_params, new_state).
+    """
+    mu, wd = config.momentum, config.weight_decay
+    new_buf = jax.tree_util.tree_map(
+        lambda p, g, b: mu * b + g + wd * p, params, grads,
+        state.momentum_buf)
+    new_params = jax.tree_util.tree_map(
+        lambda p, b: p - lr_t * b, params, new_buf)
+    return new_params, SGDState(new_buf)

@@ -1,0 +1,20 @@
+"""Model-size reporting — reference ``get_model_size`` + unit constants
+(singlegpu.py:212-225)."""
+from __future__ import annotations
+
+import jax
+
+# Reference unit constants (singlegpu.py:222-225): sizes are kept in *bits*.
+Byte = 8
+KiB = 1024 * Byte
+MiB = 1024 * KiB
+GiB = 1024 * MiB
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def get_model_size(params, data_width: int = 32) -> int:
+    """Model size in bits (reference semantics: #params * bits/param)."""
+    return count_params(params) * data_width

@@ -1,0 +1,159 @@
+"""Convert between torch state_dicts and ddp_tpu pytrees.
+
+Used by the parity tests (tests/test_models.py, tests/test_train_parity.py)
+to load torch-initialised weights into the JAX models so forward/backward/
+update numerics can be compared step-by-step against the reference math
+(SURVEY.md section 4), and to export
+checkpoints in the reference's flat ``backbone.conv0.weight``-style naming
+(multigpu.py:110, key scheme from the add() helper at multigpu.py:45-47).
+
+Layout conversions:
+- conv kernels: torch OIHW  <->  ours HWIO   (transpose (2,3,1,0))
+- linear weights: torch [out,in]  <->  ours [in,out]  (transpose)
+- DeepNN's first linear additionally permutes its input axis because torch
+  flattens NCHW and we flatten NHWC (see models/deepnn.py docstring).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    # copy=True: torch tensors share memory with their .numpy() view, and on
+    # the CPU backend jnp.asarray can be zero-copy over that view — without
+    # the copy, torch's in-place buffer updates would mutate the JAX arrays.
+    return np.array(t.detach().cpu().numpy() if hasattr(t, "detach") else t,
+                    copy=True)
+
+
+def conv_kernel_from_torch(w) -> jnp.ndarray:
+    return jnp.asarray(_np(w).transpose(2, 3, 1, 0))  # OIHW -> HWIO
+
+
+def conv_kernel_to_torch(k) -> np.ndarray:
+    return np.asarray(k).transpose(3, 2, 0, 1)  # HWIO -> OIHW
+
+
+def linear_weight_from_torch(w) -> jnp.ndarray:
+    return jnp.asarray(_np(w).T)
+
+
+def vgg_from_torch_state_dict(sd) -> Tuple[Dict, Dict]:
+    """Reference-named VGG state_dict -> (params, batch_stats)."""
+    backbone: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    i = 0
+    while f"backbone.conv{i}.weight" in sd:
+        backbone[f"conv{i}"] = {
+            "kernel": conv_kernel_from_torch(sd[f"backbone.conv{i}.weight"])}
+        backbone[f"bn{i}"] = {
+            "scale": jnp.asarray(_np(sd[f"backbone.bn{i}.weight"])),
+            "bias": jnp.asarray(_np(sd[f"backbone.bn{i}.bias"]))}
+        stats[f"bn{i}"] = {
+            "mean": jnp.asarray(_np(sd[f"backbone.bn{i}.running_mean"])),
+            "var": jnp.asarray(_np(sd[f"backbone.bn{i}.running_var"]))}
+        i += 1
+    params = {
+        "backbone": backbone,
+        "classifier": {
+            "weight": linear_weight_from_torch(sd["classifier.weight"]),
+            "bias": jnp.asarray(_np(sd["classifier.bias"]))},
+    }
+    return params, stats
+
+
+def vgg_to_torch_state_dict(params: Dict, batch_stats: Dict
+                            ) -> Dict[str, np.ndarray]:
+    """Export in the reference checkpoint key scheme (multigpu.py:110)."""
+    out: Dict[str, np.ndarray] = {}
+    backbone = params["backbone"]
+    i = 0
+    while f"conv{i}" in backbone:
+        out[f"backbone.conv{i}.weight"] = conv_kernel_to_torch(
+            backbone[f"conv{i}"]["kernel"])
+        out[f"backbone.bn{i}.weight"] = np.asarray(backbone[f"bn{i}"]["scale"])
+        out[f"backbone.bn{i}.bias"] = np.asarray(backbone[f"bn{i}"]["bias"])
+        out[f"backbone.bn{i}.running_mean"] = np.asarray(
+            batch_stats[f"bn{i}"]["mean"])
+        out[f"backbone.bn{i}.running_var"] = np.asarray(
+            batch_stats[f"bn{i}"]["var"])
+        i += 1
+    out["classifier.weight"] = np.asarray(params["classifier"]["weight"]).T
+    out["classifier.bias"] = np.asarray(params["classifier"]["bias"])
+    return out
+
+
+def deepnn_from_torch_state_dict(sd) -> Tuple[Dict, Dict]:
+    """DeepNN state_dict -> (params, {}).
+
+    Maps by tensor rank + registration order rather than by name, so any
+    ``nn.Sequential`` numbering works.  The first linear's input axis is
+    permuted from torch's channel-major flatten to our NHWC flatten.
+    """
+    conv_ws = [v for k, v in sd.items() if _np(v).ndim == 4]
+    conv_bs = [v for k, v in sd.items()
+               if _np(v).ndim == 1 and "features" in k]
+    lin_ws = [v for k, v in sd.items() if _np(v).ndim == 2]
+    lin_bs = [v for k, v in sd.items()
+              if _np(v).ndim == 1 and "classifier" in k]
+    assert len(conv_ws) == 4 and len(lin_ws) == 2
+
+    features = {
+        f"conv{i}": {"kernel": conv_kernel_from_torch(conv_ws[i]),
+                     "bias": jnp.asarray(_np(conv_bs[i]))}
+        for i in range(4)
+    }
+    w0 = _np(lin_ws[0])                       # [512, 2048], input = (c,h,w)
+    w0 = w0.reshape(512, 32, 8, 8).transpose(0, 2, 3, 1).reshape(512, 2048)
+    params = {
+        "features": features,
+        "classifier": {
+            "linear0": {"weight": jnp.asarray(w0.T),
+                        "bias": jnp.asarray(_np(lin_bs[0]))},
+            "linear1": {"weight": linear_weight_from_torch(lin_ws[1]),
+                        "bias": jnp.asarray(_np(lin_bs[1]))},
+        },
+    }
+    return params, {}
+
+
+def _bn_from_torch(sd, prefix: str) -> Tuple[Dict, Dict]:
+    return ({"scale": jnp.asarray(_np(sd[f"{prefix}.weight"])),
+             "bias": jnp.asarray(_np(sd[f"{prefix}.bias"]))},
+            {"mean": jnp.asarray(_np(sd[f"{prefix}.running_mean"])),
+             "var": jnp.asarray(_np(sd[f"{prefix}.running_var"]))})
+
+
+def resnet18_from_torch_state_dict(sd) -> Tuple[Dict, Dict]:
+    """torchvision.models.resnet18 state_dict -> (params, batch_stats)."""
+    params: Dict[str, Any] = {}
+    stats: Dict[str, Any] = {}
+    params["conv1"] = {"kernel": conv_kernel_from_torch(sd["conv1.weight"])}
+    params["bn1"], stats["bn1"] = _bn_from_torch(sd, "bn1")
+    for si in range(1, 5):
+        for bi in range(2):
+            tp = f"layer{si}.{bi}"
+            name = f"layer{si}.block{bi}"
+            blk: Dict[str, Any] = {}
+            bst: Dict[str, Any] = {}
+            blk["conv1"] = {"kernel": conv_kernel_from_torch(
+                sd[f"{tp}.conv1.weight"])}
+            blk["bn1"], bst["bn1"] = _bn_from_torch(sd, f"{tp}.bn1")
+            blk["conv2"] = {"kernel": conv_kernel_from_torch(
+                sd[f"{tp}.conv2.weight"])}
+            blk["bn2"], bst["bn2"] = _bn_from_torch(sd, f"{tp}.bn2")
+            if f"{tp}.downsample.0.weight" in sd:
+                ds_bn, ds_st = _bn_from_torch(sd, f"{tp}.downsample.1")
+                blk["downsample"] = {
+                    "conv": {"kernel": conv_kernel_from_torch(
+                        sd[f"{tp}.downsample.0.weight"])},
+                    "bn": ds_bn}
+                bst["downsample_bn"] = ds_st
+            params[name] = blk
+            stats[name] = bst
+    params["fc"] = {"weight": linear_weight_from_torch(sd["fc.weight"]),
+                    "bias": jnp.asarray(_np(sd["fc.bias"]))}
+    return params, stats

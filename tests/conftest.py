@@ -1,0 +1,25 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX imports.
+
+The reference (multigpu.py:262-263) tests distribution by spawning one process
+per physical GPU; we instead simulate an 8-device TPU slice on CPU so the whole
+distributed surface is exercised in CI without hardware (SURVEY.md section 4).
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon TPU-tunnel plugin in this image overrides JAX_PLATFORMS, so pin
+# the platform through jax.config as well (must happen before any backend
+# initialisation).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Make the repo root importable regardless of pytest rootdir configuration.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,140 @@
+"""Model-level parity vs torch CPU: param counts, forward numerics, BN stats."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from ddp_tpu.models import get_model
+from ddp_tpu.utils.model_size import MiB, count_params, get_model_size
+from ddp_tpu.utils.torch_interop import (deepnn_from_torch_state_dict,
+                                         vgg_from_torch_state_dict,
+                                         vgg_to_torch_state_dict)
+
+from torch_ref import TorchDeepNN, TorchVGG
+
+
+def test_vgg_param_count_and_size():
+    """9,228,362 params / 35.20 MiB fp32 — SURVEY.md 2.4, reference
+    singlegpu.py:238-239."""
+    params, _ = get_model("vgg").init(jax.random.PRNGKey(0))
+    assert count_params(params) == 9_228_362
+    assert f"{get_model_size(params) / MiB:.2f}" == "35.20"
+
+
+def test_deepnn_param_count():
+    params, _ = get_model("deepnn").init(jax.random.PRNGKey(0))
+    assert count_params(params) == 1_186_986
+
+
+def test_vgg_forward_parity_eval():
+    torch.manual_seed(0)
+    tm = TorchVGG().eval()
+    params, stats = vgg_from_torch_state_dict(tm.state_dict())
+    x = torch.randn(4, 3, 32, 32)
+    with torch.no_grad():
+        ref = tm(x).numpy()
+    ours, _ = get_model("vgg").apply(
+        params, stats, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)),
+        train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_vgg_forward_parity_train_and_bn_stats():
+    torch.manual_seed(1)
+    tm = TorchVGG().train()
+    params, stats = vgg_from_torch_state_dict(tm.state_dict())
+    x = torch.randn(8, 3, 32, 32)
+    ref = tm(x).detach().numpy()
+    ours, new_stats = get_model("vgg").apply(
+        params, stats, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)),
+        train=True)
+    # Train mode divides by per-batch std at each of the 8 BN layers, which
+    # amplifies backend-level fp32 reduction-order differences slightly.
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-3)
+    # Running stats advanced identically (torch mutated its buffers in-place).
+    sd = tm.state_dict()
+    for i in [0, 3, 7]:
+        np.testing.assert_allclose(
+            np.asarray(new_stats[f"bn{i}"]["mean"]),
+            sd[f"backbone.bn{i}.running_mean"].numpy(), rtol=1e-3, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(new_stats[f"bn{i}"]["var"]),
+            sd[f"backbone.bn{i}.running_var"].numpy(), rtol=1e-3, atol=1e-5)
+
+
+def test_deepnn_forward_parity_eval():
+    torch.manual_seed(2)
+    tm = TorchDeepNN().eval()
+    params, stats = deepnn_from_torch_state_dict(tm.state_dict())
+    x = torch.randn(4, 3, 32, 32)
+    with torch.no_grad():
+        ref = tm(x).numpy()
+    ours, _ = get_model("deepnn").apply(
+        params, stats, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)),
+        train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_deepnn_train_mode_dropout():
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 32, 32, 3)) + 0.5
+    out1, _ = model.apply(params, stats, x, train=True,
+                          rng=jax.random.PRNGKey(1))
+    out2, _ = model.apply(params, stats, x, train=True,
+                          rng=jax.random.PRNGKey(2))
+    assert out1.shape == (2, 10)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_vgg_state_dict_round_trip():
+    torch.manual_seed(3)
+    tm = TorchVGG()
+    params, stats = vgg_from_torch_state_dict(tm.state_dict())
+    exported = vgg_to_torch_state_dict(params, stats)
+    sd = tm.state_dict()
+    for k, v in exported.items():
+        np.testing.assert_array_equal(v, sd[k].numpy())
+    # Same keys as the reference checkpoint (minus num_batches_tracked).
+    ref_keys = {k for k in sd if "num_batches_tracked" not in k}
+    assert set(exported) == ref_keys
+
+
+def test_vgg_bf16_compute_close_to_fp32():
+    model = get_model("vgg")
+    params, stats = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    full, _ = model.apply(params, stats, x, train=False)
+    half, _ = model.apply(params, stats, x, train=False,
+                          compute_dtype=jnp.bfloat16)
+    assert half.dtype == jnp.float32  # logits promoted back for the loss
+    np.testing.assert_allclose(np.asarray(half), np.asarray(full),
+                               rtol=0.15, atol=0.15)
+
+
+def test_resnet18_forward_parity_eval():
+    from ddp_tpu.utils.torch_interop import resnet18_from_torch_state_dict
+    from torch_ref import TorchResNet18
+    torch.manual_seed(4)
+    tm = TorchResNet18(num_classes=10).eval()
+    params, stats = resnet18_from_torch_state_dict(tm.state_dict())
+    from ddp_tpu.utils.model_size import count_params as cp
+    assert cp(params) == sum(p.numel() for p in tm.parameters())
+    x = torch.randn(4, 3, 32, 32)
+    with torch.no_grad():
+        ref = tm(x).numpy()
+    ours, _ = get_model("resnet18").apply(
+        params, stats, jnp.asarray(x.numpy().transpose(0, 2, 3, 1)),
+        train=False)
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_resnet18_own_init_trains_shape():
+    model = get_model("resnet18")
+    params, stats = model.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+    logits, new_stats = model.apply(params, stats, x, train=True)
+    assert logits.shape == (8, 10)
+    # train mode must advance the stem BN running stats
+    assert not np.allclose(np.asarray(new_stats["bn1"]["mean"]),
+                           np.asarray(stats["bn1"]["mean"]))

@@ -1,0 +1,144 @@
+"""Op-level numerics parity vs torch CPU (SURVEY.md section 4 test strategy)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from ddp_tpu.ops import (batch_norm, conv2d, cross_entropy_per_example,
+                         cross_entropy_sum_count, global_avg_pool, linear,
+                         max_pool)
+from ddp_tpu.ops.layers import BatchNormState
+from ddp_tpu.ops import initializers as init_lib
+
+
+def rand(*shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(np.float32)
+
+
+def test_conv2d_matches_torch():
+    x = rand(4, 8, 8, 3)
+    w = rand(3, 3, 3, 16, seed=1) * 0.1
+    ours = conv2d(jnp.asarray(x), jnp.asarray(w), padding=1)
+    theirs = F.conv2d(torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                      torch.from_numpy(w.transpose(3, 2, 0, 1)), padding=1)
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_with_bias_and_stride():
+    x = rand(2, 9, 9, 4)
+    w = rand(3, 3, 4, 8, seed=2) * 0.1
+    b = rand(8, seed=3)
+    ours = conv2d(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                  stride=2, padding=1)
+    theirs = F.conv2d(torch.from_numpy(x.transpose(0, 3, 1, 2)),
+                      torch.from_numpy(w.transpose(3, 2, 0, 1)),
+                      torch.from_numpy(b), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_max_pool_matches_torch():
+    x = rand(4, 8, 8, 5)
+    ours = max_pool(jnp.asarray(x))
+    theirs = F.max_pool2d(torch.from_numpy(x.transpose(0, 3, 1, 2)), 2)
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.numpy().transpose(0, 2, 3, 1))
+
+
+def test_batch_norm_train_matches_torch():
+    x = rand(8, 4, 4, 6)
+    bn = torch.nn.BatchNorm2d(6)
+    bn.train()
+    with torch.no_grad():
+        bn.weight.copy_(torch.from_numpy(rand(6, seed=5) * 0.5 + 1.0))
+        bn.bias.copy_(torch.from_numpy(rand(6, seed=6) * 0.1))
+    theirs = bn(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    state = BatchNormState(jnp.zeros(6), jnp.ones(6))
+    ours, new_state = batch_norm(
+        jnp.asarray(x), jnp.asarray(bn.weight.detach().numpy()),
+        jnp.asarray(bn.bias.detach().numpy()), state, train=True)
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.detach().numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-5, atol=1e-5)
+    # Running-stat update must match torch's (unbiased var, momentum 0.1).
+    np.testing.assert_allclose(np.asarray(new_state.mean),
+                               bn.running_mean.numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_state.var),
+                               bn.running_var.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_batch_norm_eval_uses_running_stats():
+    x = rand(4, 2, 2, 3)
+    bn = torch.nn.BatchNorm2d(3)
+    bn.eval()
+    with torch.no_grad():
+        bn.running_mean.copy_(torch.from_numpy(rand(3, seed=7)))
+        bn.running_var.copy_(torch.from_numpy(np.abs(rand(3, seed=8)) + 0.5))
+    theirs = bn(torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    state = BatchNormState(jnp.asarray(bn.running_mean.numpy()),
+                           jnp.asarray(bn.running_var.numpy()))
+    ours, new_state = batch_norm(jnp.asarray(x), jnp.ones(3), jnp.zeros(3),
+                                 state, train=False)
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.detach().numpy().transpose(0, 2, 3, 1),
+                               rtol=1e-5, atol=1e-5)
+    assert new_state is state  # eval must not touch the stats
+
+
+def test_cross_entropy_matches_torch():
+    logits = rand(16, 10)
+    labels = np.arange(16) % 10
+    ours = cross_entropy_per_example(jnp.asarray(logits), jnp.asarray(labels))
+    theirs = F.cross_entropy(torch.from_numpy(logits),
+                             torch.from_numpy(labels), reduction="none")
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    s, n = cross_entropy_sum_count(jnp.asarray(logits), jnp.asarray(labels))
+    assert n == 16.0
+    np.testing.assert_allclose(float(s) / float(n),
+                               float(F.cross_entropy(torch.from_numpy(logits),
+                                                     torch.from_numpy(labels))),
+                               rtol=1e-6)
+
+
+def test_cross_entropy_mask_ignores_padding():
+    logits = rand(8, 10)
+    labels = np.arange(8) % 10
+    mask = np.array([1, 1, 1, 1, 1, 0, 0, 0], np.bool_)
+    s_masked, n = cross_entropy_sum_count(jnp.asarray(logits),
+                                          jnp.asarray(labels),
+                                          jnp.asarray(mask))
+    s_short, _ = cross_entropy_sum_count(jnp.asarray(logits[:5]),
+                                         jnp.asarray(labels[:5]))
+    assert n == 5.0
+    np.testing.assert_allclose(float(s_masked), float(s_short), rtol=1e-6)
+
+
+def test_global_avg_pool_and_linear():
+    x = rand(3, 2, 2, 7)
+    np.testing.assert_allclose(
+        np.asarray(global_avg_pool(jnp.asarray(x))),
+        x.mean(axis=(1, 2)), rtol=1e-6)
+    w, b = rand(7, 4, seed=9), rand(4, seed=10)
+    np.testing.assert_allclose(
+        np.asarray(linear(global_avg_pool(jnp.asarray(x)), jnp.asarray(w),
+                          jnp.asarray(b))),
+        x.mean(axis=(1, 2)) @ w + b, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("fan_in,shape", [(27, (3, 3, 3, 64)),
+                                          (512, (512, 10))])
+def test_torch_default_init_bounds(fan_in, shape):
+    key = jax.random.PRNGKey(0)
+    w = init_lib.torch_default_uniform(key, shape, fan_in)
+    bound = 1.0 / np.sqrt(fan_in)
+    w = np.asarray(w)
+    assert w.max() <= bound and w.min() >= -bound
+    # Uniform over the full interval: std should be near bound/sqrt(3).
+    np.testing.assert_allclose(w.std(), bound / np.sqrt(3), rtol=0.1)

@@ -1,0 +1,75 @@
+"""SGD update rule + LR schedule golden-tested against torch per-step
+(SURVEY.md section 7 step 1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from ddp_tpu.optim import (SGDConfig, apply_updates, triangular_lr)
+from ddp_tpu.optim import init as sgd_init
+
+from torch_ref import reference_lr_lambda
+
+
+def test_sgd_matches_torch_over_ten_steps():
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(5, 3).astype(np.float32)
+    b0 = rng.randn(3).astype(np.float32)
+
+    tw = torch.nn.Parameter(torch.from_numpy(w0.copy()))
+    tb = torch.nn.Parameter(torch.from_numpy(b0.copy()))
+    opt = torch.optim.SGD([tw, tb], lr=0.4, momentum=0.9, weight_decay=5e-4)
+    sched = torch.optim.lr_scheduler.LambdaLR(
+        opt, reference_lr_lambda(num_epochs=20, steps_per_epoch=4))
+
+    params = {"w": jnp.asarray(w0), "b": jnp.asarray(b0)}
+    state = sgd_init(params)
+    cfg = SGDConfig()
+
+    for step in range(10):
+        gw = rng.randn(5, 3).astype(np.float32)
+        gb = rng.randn(3).astype(np.float32)
+        opt.zero_grad()
+        tw.grad = torch.from_numpy(gw.copy())
+        tb.grad = torch.from_numpy(gb.copy())
+        opt.step()
+        sched.step()
+
+        lr_t = triangular_lr(jnp.asarray(step, jnp.float32),
+                             steps_per_epoch=4)
+        params, state = apply_updates(
+            params, {"w": jnp.asarray(gw), "b": jnp.asarray(gb)},
+            state, lr_t, cfg)
+        np.testing.assert_allclose(np.asarray(params["w"]),
+                                   tw.detach().numpy(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(params["b"]),
+                                   tb.detach().numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_triangular_lr_matches_reference_interp():
+    lam = reference_lr_lambda(num_epochs=20, steps_per_epoch=98)
+    for step in [0, 1, 97, 98, 500, 588, 1000, 1959, 1960, 2500]:
+        expected = 0.4 * lam(step)
+        got = float(triangular_lr(jnp.asarray(step, jnp.float32),
+                                  steps_per_epoch=98))
+        np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-8)
+
+
+def test_lr_is_zero_at_start_and_end():
+    assert float(triangular_lr(jnp.asarray(0.0))) == 0.0
+    assert float(triangular_lr(jnp.asarray(98.0 * 20))) == 0.0
+    assert float(triangular_lr(jnp.asarray(98.0 * 25))) == 0.0  # clipped past end
+    np.testing.assert_allclose(
+        float(triangular_lr(jnp.asarray(98.0 * 6))), 0.4, rtol=1e-6)
+
+
+def test_weight_decay_applies_to_all_params():
+    # The reference passes model.parameters() wholesale (singlegpu.py:136),
+    # so BN scale/bias decay too; our trainer must do the same.
+    params = {"bn_scale": jnp.ones(4)}
+    state = sgd_init(params)
+    new_params, _ = apply_updates(
+        params, {"bn_scale": jnp.zeros(4)}, state,
+        jnp.asarray(1.0), SGDConfig())
+    np.testing.assert_allclose(np.asarray(new_params["bn_scale"]),
+                               np.full(4, 1.0 - 5e-4), rtol=1e-6)

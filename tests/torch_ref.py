@@ -1,0 +1,128 @@
+"""Torch CPU reference builders for numerics-parity tests.
+
+These re-derive the architectures/optimizer math documented in SURVEY.md
+sections 2.4, 2.5, 2.9 (reference singlegpu.py:18-44, 47-82, 135-149) so the
+JAX implementation can be checked step-by-step against the exact reference
+semantics.  This module is test-only; the framework itself has no torch
+dependency.
+"""
+from collections import OrderedDict
+
+import numpy as np
+import torch
+import torch.nn as nn
+
+VGG_CFG = [64, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]
+
+
+class TorchVGG(nn.Module):
+    def __init__(self):
+        super().__init__()
+        seq, counts, in_ch = OrderedDict(), {}, 3
+
+        def tag(prefix):
+            n = counts.get(prefix, 0)
+            counts[prefix] = n + 1
+            return f"{prefix}{n}"
+
+        for v in VGG_CFG:
+            if v == "M":
+                seq[tag("pool")] = nn.MaxPool2d(2)
+            else:
+                seq[tag("conv")] = nn.Conv2d(in_ch, v, 3, padding=1,
+                                             bias=False)
+                seq[tag("bn")] = nn.BatchNorm2d(v)
+                seq[tag("relu")] = nn.ReLU(True)
+                in_ch = v
+        self.backbone = nn.Sequential(seq)
+        self.classifier = nn.Linear(512, 10)
+
+    def forward(self, x):
+        return self.classifier(self.backbone(x).mean([2, 3]))
+
+
+class TorchDeepNN(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(3, 128, 3, padding=1), nn.ReLU(),
+            nn.Conv2d(128, 64, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2, 2),
+            nn.Conv2d(64, 64, 3, padding=1), nn.ReLU(),
+            nn.Conv2d(64, 32, 3, padding=1), nn.ReLU(), nn.MaxPool2d(2, 2),
+        )
+        self.classifier = nn.Sequential(
+            nn.Linear(2048, 512), nn.ReLU(), nn.Dropout(0.1),
+            nn.Linear(512, 10),
+        )
+
+    def forward(self, x):
+        return self.classifier(torch.flatten(self.features(x), 1))
+
+
+def reference_lr_lambda(num_epochs=20, steps_per_epoch=98):
+    """Triangular schedule multiplier (reference singlegpu.py:142-148)."""
+    def lr_lambda(step):
+        return float(np.interp([step / steps_per_epoch],
+                               [0, num_epochs * 0.3, num_epochs], [0, 1, 0])[0])
+    return lr_lambda
+
+
+def make_reference_optimizer(model, lr=0.4, momentum=0.9, weight_decay=5e-4,
+                             num_epochs=20, steps_per_epoch=98):
+    """SGD + per-batch LambdaLR, exactly as singlegpu.py:135-149."""
+    opt = torch.optim.SGD(model.parameters(), lr=lr, momentum=momentum,
+                          weight_decay=weight_decay)
+    sched = torch.optim.lr_scheduler.LambdaLR(
+        opt, reference_lr_lambda(num_epochs, steps_per_epoch))
+    return opt, sched
+
+
+def nhwc(x_nchw: torch.Tensor) -> np.ndarray:
+    return x_nchw.detach().numpy().transpose(0, 2, 3, 1)
+
+
+class _BasicBlock(nn.Module):
+    def __init__(self, in_ch, out_ch, stride):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, stride, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(out_ch)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, 1, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(out_ch)
+        self.downsample = None
+        if stride != 1 or in_ch != out_ch:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(in_ch, out_ch, 1, stride, bias=False),
+                nn.BatchNorm2d(out_ch))
+
+    def forward(self, x):
+        idt = x if self.downsample is None else self.downsample(x)
+        y = torch.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return torch.relu(y + idt)
+
+
+class TorchResNet18(nn.Module):
+    """torchvision.models.resnet18-compatible state_dict naming/init
+    (torchvision itself is not installed in this image)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        widths, in_ch = [(64, 1), (128, 2), (256, 2), (512, 2)], 64
+        for i, (w, s) in enumerate(widths, start=1):
+            setattr(self, f"layer{i}", nn.Sequential(
+                _BasicBlock(in_ch, w, s), _BasicBlock(w, w, 1)))
+            in_ch = w
+        self.fc = nn.Linear(512, num_classes)
+        for m in self.modules():
+            if isinstance(m, nn.Conv2d):
+                nn.init.kaiming_normal_(m.weight, mode="fan_out",
+                                        nonlinearity="relu")
+
+    def forward(self, x):
+        x = self.maxpool(torch.relu(self.bn1(self.conv1(x))))
+        for i in range(1, 5):
+            x = getattr(self, f"layer{i}")(x)
+        return self.fc(x.mean(dim=(2, 3)))
