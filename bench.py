@@ -1,0 +1,87 @@
+"""Benchmark: steady-state training throughput of the flagship model (VGG on
+CIFAR-shaped data, the reference's workload — singlegpu.py:134, batch 512,
+multigpu.py:259).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.  The
+reference publishes no numbers (SURVEY.md §6; BASELINE.json "published": {}),
+so ``vs_baseline`` is reported against this framework's recorded fp32
+baseline when present in BASELINE_BENCH (below), else 1.0.
+
+Measures the jitted SPMD train step with device-resident data (compile time
+and input pipeline excluded — steady-state chip throughput, the
+samples/sec/chip metric BASELINE.json names).  Runs on whatever devices JAX
+sees: the one real TPU chip under the driver, or a CPU mesh locally.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_tpu.data import synthetic
+from ddp_tpu.models import get_model
+from ddp_tpu.optim import SGDConfig, triangular_lr
+from ddp_tpu.parallel import make_mesh
+from ddp_tpu.train import make_train_step, shard_batch
+from ddp_tpu.train.step import init_train_state
+
+# Recorded fp32 samples/sec/chip from earlier rounds on the driver's TPU
+# (None until a first real-TPU number exists to compare against).
+BASELINE_BENCH = None
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="vgg")
+    p.add_argument("--batch_size", default=512, type=int)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--steps", default=20, type=int)
+    p.add_argument("--warmup", default=5, type=int)
+    args = p.parse_args()
+
+    mesh = make_mesh()
+    n_chips = mesh.devices.size
+    model = get_model(args.model)
+    params, stats = model.init(jax.random.key(0))
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    schedule = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
+                                 steps_per_epoch=98)
+    step_fn = make_train_step(model, SGDConfig(), schedule, mesh,
+                              compute_dtype=compute_dtype)
+
+    global_batch = args.batch_size * n_chips
+    ds, _ = synthetic(n_train=global_batch, n_test=1)
+    batch = shard_batch({"image": ds.images.astype(np.float32) / 255.0,
+                         "label": ds.labels}, mesh)
+    state = init_train_state(params, stats)
+    rng = jax.random.key(0)
+
+    # At least one warmup step always runs (it also triggers compilation).
+    for _ in range(max(args.warmup, 1)):
+        state, loss = step_fn(state, batch, rng)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, loss = step_fn(state, batch, rng)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    sps_chip = global_batch * args.steps / dt / n_chips
+    vs = sps_chip / BASELINE_BENCH if BASELINE_BENCH else 1.0
+    print(json.dumps({
+        "metric": f"{args.model} train samples/sec/chip "
+                  f"(batch {args.batch_size}/chip, "
+                  f"{'bf16' if args.bf16 else 'fp32'}, {n_chips} chip(s))",
+        "value": round(sps_chip, 2),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

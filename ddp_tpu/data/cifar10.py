@@ -1,0 +1,73 @@
+"""CIFAR-10 dataset loading.
+
+The reference uses ``torchvision.datasets.CIFAR10(root="data/cifar10",
+download=True)`` (singlegpu.py:161-171).  We read the same on-disk layout
+(the python-pickle batches ``cifar-10-batches-py/data_batch_{1..5}`` +
+``test_batch``) directly with numpy — torchvision is not a given on TPU
+hosts, and the unpickled arrays feed the vectorised augmentation pipeline
+(``augment.py``) without a per-sample Python transform stage.
+
+No network download is attempted (TPU pods are usually egress-less); if the
+data is absent the error says where to put it.  ``synthetic()`` provides a
+deterministic stand-in with the same shapes/dtypes for tests and benches.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import NamedTuple, Tuple
+
+import numpy as np
+
+DEFAULT_ROOT = "data/cifar10"
+_BATCH_DIR = "cifar-10-batches-py"
+NUM_CLASSES = 10
+
+
+class Dataset(NamedTuple):
+    images: np.ndarray  # uint8 [N,32,32,3] (NHWC — the TPU-native layout)
+    labels: np.ndarray  # int32 [N]
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+
+def _load_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    with open(path, "rb") as f:
+        d = pickle.load(f, encoding="bytes")
+    imgs = d[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    labels = np.asarray(d.get(b"labels", d.get(b"fine_labels")), np.int32)
+    return np.ascontiguousarray(imgs), labels
+
+
+def load(root: str = DEFAULT_ROOT) -> Tuple[Dataset, Dataset]:
+    """(train 50k, test 10k) from the standard pickle layout."""
+    base = os.path.join(root, _BATCH_DIR)
+    if not os.path.isdir(base):
+        raise FileNotFoundError(
+            f"CIFAR-10 not found under {base!r}. Place the extracted "
+            "'cifar-10-batches-py' directory there (the reference's "
+            "torchvision download layout), or run with --synthetic.")
+    train_parts = [_load_batch(os.path.join(base, f"data_batch_{i}"))
+                   for i in range(1, 6)]
+    train = Dataset(np.concatenate([p[0] for p in train_parts]),
+                    np.concatenate([p[1] for p in train_parts]))
+    test = Dataset(*_load_batch(os.path.join(base, "test_batch")))
+    return train, test
+
+
+def synthetic(n_train: int = 2048, n_test: int = 512,
+              seed: int = 0) -> Tuple[Dataset, Dataset]:
+    """Deterministic fake CIFAR with a learnable signal: the label is
+    encoded in each image's mean brightness, so a real model trained on it
+    shows a decreasing loss (needed for end-to-end tests, SURVEY.md §4)."""
+    rng = np.random.default_rng(seed)
+
+    def make(n: int) -> Dataset:
+        labels = rng.integers(0, NUM_CLASSES, n).astype(np.int32)
+        base = rng.integers(0, 64, (n, 32, 32, 3))
+        imgs = np.clip(base + (labels * 18)[:, None, None, None],
+                       0, 255).astype(np.uint8)
+        return Dataset(imgs, labels)
+
+    return make(n_train), make(n_test)

@@ -1,0 +1,123 @@
+"""Batched loaders feeding the SPMD train/eval steps.
+
+The reference feeds each rank from its own ``DataLoader`` over a
+``DistributedSampler`` shard (multigpu.py:147-154); global batch k is then
+implicitly {rank r's batch k}.  Our single-process SPMD program consumes
+*global* batches sharded on the leading axis, so ``TrainLoader`` materialises
+exactly that concatenation: row block r of global batch k == what rank r's
+DataLoader would have yielded — device r therefore sees precisely rank r's
+reference data stream, preserving per-shard BN statistics and the gradient
+mean.
+
+Ragged final batches are yielded at their true size (50000 isn't divisible by
+512; every replica's shard is equally ragged thanks to sampler padding), which
+costs one extra XLA compilation for the remainder shape instead of perturbing
+the loss mean or BN stats with padding (SURVEY.md §7 hard-part #3).  Eval
+batches are padded+masked instead — eval has masked counters, so padding is
+free there.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from .augment import random_crop_flip, to_float
+from .cifar10 import Dataset
+from .sampler import DistributedShardSampler, ShuffleSampler
+
+
+class TrainLoader:
+    """Epoch-aware global-batch iterator with reference sampler semantics.
+
+    ``per_replica_batch`` is the reference's ``--batch_size`` (512/rank,
+    multigpu.py:259); the global batch is ``per_replica_batch *
+    num_replicas``.  ``local_replicas`` restricts which replicas' rows this
+    process materialises (multi-host feeding: host h passes its own chips'
+    replica ids and hands the result to
+    ``jax.make_array_from_process_local_data``).
+    """
+
+    def __init__(self, dataset: Dataset, per_replica_batch: int,
+                 num_replicas: int = 1, *, shuffle: bool = True,
+                 augment: bool = True, seed: int = 0,
+                 local_replicas: Optional[range] = None):
+        self.dataset = dataset
+        self.per_replica_batch = per_replica_batch
+        self.num_replicas = num_replicas
+        self.augment = augment
+        self.seed = seed
+        self.epoch = 0
+        self.local_replicas = (range(num_replicas) if local_replicas is None
+                               else local_replicas)
+        if num_replicas > 1:
+            self.samplers = [
+                DistributedShardSampler(len(dataset), num_replicas, r,
+                                        shuffle=shuffle, seed=seed)
+                for r in self.local_replicas]
+        else:
+            self.samplers = [ShuffleSampler(len(dataset), shuffle=shuffle,
+                                            seed=seed)]
+        self.steps_per_epoch = -(-len(self.samplers[0]) // per_replica_batch)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reference ``sampler.set_epoch`` (multigpu.py:103)."""
+        self.epoch = epoch
+        for s in self.samplers:
+            s.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self.steps_per_epoch
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        shards = [s.indices() for s in self.samplers]
+        rng = np.random.default_rng((self.seed, self.epoch, 0x5EED))
+        b = self.per_replica_batch
+        for k in range(self.steps_per_epoch):
+            idx = np.concatenate([sh[k * b:(k + 1) * b] for sh in shards])
+            imgs = self.dataset.images[idx]
+            if self.augment:
+                imgs = random_crop_flip(imgs, rng)
+            yield {"image": to_float(imgs),
+                   "label": self.dataset.labels[idx]}
+
+
+class EvalLoader:
+    """Sequential test-set batches, padded+masked to mesh divisibility.
+
+    Reference: batch 512, shuffle=False, full set (multigpu.py:240-246) —
+    but evaluated redundantly per rank; with masked ``psum`` counters we
+    shard it instead (same result, SURVEY.md appendix).
+    """
+
+    def __init__(self, dataset: Dataset, per_replica_batch: int,
+                 num_replicas: int = 1,
+                 local_replicas: Optional[range] = None):
+        self.dataset = dataset
+        self.global_batch = per_replica_batch * num_replicas
+        self.num_replicas = num_replicas
+        self.local_replicas = (range(num_replicas) if local_replicas is None
+                               else local_replicas)
+
+    def __len__(self) -> int:
+        return -(-len(self.dataset) // self.global_batch)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self.dataset)
+        for start in range(0, n, self.global_batch):
+            imgs = self.dataset.images[start:start + self.global_batch]
+            labels = self.dataset.labels[start:start + self.global_batch]
+            size = len(imgs)
+            pad = -size % self.num_replicas
+            mask = np.ones(size, np.float32)
+            if pad:
+                imgs = np.concatenate([imgs, np.zeros_like(imgs[:pad])])
+                labels = np.concatenate([labels, np.zeros(pad, labels.dtype)])
+                mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+            if len(self.local_replicas) != self.num_replicas:
+                # Multi-host: keep only this host's replicas' row blocks.
+                per = len(imgs) // self.num_replicas
+                rows = np.concatenate([np.arange(r * per, (r + 1) * per)
+                                       for r in self.local_replicas])
+                imgs, labels, mask = imgs[rows], labels[rows], mask[rows]
+            yield {"image": to_float(imgs), "label": labels, "mask": mask}

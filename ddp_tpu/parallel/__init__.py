@@ -1,0 +1,9 @@
+from .mesh import (DATA_AXIS, batch_sharding, local_batch_slice, make_mesh,
+                   replicated_sharding)
+from .dist import initialize, process_count, process_index, shutdown
+
+__all__ = [
+    "DATA_AXIS", "batch_sharding", "local_batch_slice", "make_mesh",
+    "replicated_sharding", "initialize", "process_count", "process_index",
+    "shutdown",
+]

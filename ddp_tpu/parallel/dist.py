@@ -1,0 +1,89 @@
+"""Multi-host runtime init — the TPU-native ``ddp_setup`` (multigpu.py:24-33).
+
+The reference rendezvous is env-var TCP (``MASTER_ADDR=localhost``,
+``MASTER_PORT=12355``, multigpu.py:30-31) followed by
+``init_process_group(backend="nccl")``.  On TPU the same role is played by
+``jax.distributed.initialize``: a coordinator address plus process count/id,
+after which every host sees the full global device set and XLA owns the
+collective schedule.  Single-host runs need no initialization at all — the
+mesh over local devices just works — so this module no-ops unless a
+multi-host environment is configured.
+
+Env surface (mirroring the reference's MASTER_ADDR/MASTER_PORT knobs):
+  DDP_TPU_COORDINATOR   "host:port" of process 0
+  DDP_TPU_NUM_PROCESSES total host count
+  DDP_TPU_PROCESS_ID    this host's id
+On TPU pods proper these are auto-detected by JAX from the pod metadata, so
+``initialize()`` with no env set simply calls through when JAX can
+self-configure, and silently stays single-host otherwise.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Idempotent multi-host init (reference multigpu.py:32)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator = coordinator or os.environ.get("DDP_TPU_COORDINATOR")
+    num_processes = num_processes if num_processes is not None else (
+        int(os.environ["DDP_TPU_NUM_PROCESSES"])
+        if "DDP_TPU_NUM_PROCESSES" in os.environ else None)
+    process_id = process_id if process_id is not None else (
+        int(os.environ["DDP_TPU_PROCESS_ID"])
+        if "DDP_TPU_PROCESS_ID" in os.environ else None)
+    if coordinator is None and num_processes is None:
+        if _on_multiworker_tpu_pod():
+            # TPU pod with no explicit env: JAX self-configures from the
+            # pod metadata (coordinator, process count/id all auto).
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except RuntimeError:
+                # Backend already initialised (e.g. a host that probed
+                # devices first) — proceed single-host rather than abort.
+                pass
+        return  # plain single-host: nothing to rendezvous
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def _on_multiworker_tpu_pod() -> bool:
+    """True only in a genuinely multi-worker TPU environment.  Single-worker
+    markers (``TPU_WORKER_ID=0`` alone, as some single-chip runtimes set)
+    must NOT trigger auto-init, or a rendezvous is attempted that can never
+    complete / clashes with an already-initialised backend."""
+    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h]) > 1
+
+
+def shutdown() -> None:
+    """Reference ``destroy_process_group()`` (multigpu.py:250)."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    """Rank of this host — gates checkpoint writes (multigpu.py:118)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()

@@ -1,0 +1,66 @@
+"""Device mesh + shardings — the TPU-native data-parallel substrate.
+
+The reference's entire distribution layer (NCCL process group at
+multigpu.py:24-33, ``DDP(model, device_ids=[gpu_id])`` at multigpu.py:89,
+one process per GPU via ``mp.spawn`` at multigpu.py:262-263) collapses here
+into a 1-D ``jax.sharding.Mesh`` over all chips plus two ``NamedSharding``s:
+batches split along the ``data`` axis, params/optimizer state replicated.
+XLA lowers the gradient ``pmean`` inside the jitted train step to an
+all-reduce over ICI (DCN across slices) — there is no NCCL-like library to
+manage and no per-rank process fan-out; one process per *host* drives all
+its local chips SPMD.
+
+The mesh is deliberately 1-D for parity with the reference (DP is the only
+parallelism it has — SURVEY.md §2 checklist), but every consumer takes the
+mesh as an argument so a second (``model``) axis can be added without
+touching the train step's callers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              devices: Optional[list] = None) -> Mesh:
+    """1-D data-parallel mesh over ``num_devices`` (default: all) chips.
+
+    ``make_mesh(1)`` is the singlegpu.py path, ``make_mesh()`` the
+    multigpu.py path — the reference's one structural diff (SURVEY.md §1)
+    expressed as a mesh shape.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading (batch) axis split across ``data`` — the analogue of
+    ``DistributedSampler`` handing each rank its shard (multigpu.py:153)."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated — params/opt-state, like DDP's per-rank replicas
+    kept in lockstep (multigpu.py:89, 97)."""
+    return NamedSharding(mesh, P())
+
+
+def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
+    """Per-host slice of a global batch (multi-host data feeding)."""
+    if global_batch % mesh.devices.size:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by mesh size "
+            f"{mesh.devices.size}")
+    per_device = global_batch // mesh.devices.size
+    return per_device * jax.local_device_count()

@@ -1,0 +1,9 @@
+from .checkpoint import load_checkpoint, save_checkpoint
+from .evaluate import evaluate
+from .step import TrainState, make_eval_step, make_train_step, shard_batch
+from .trainer import Trainer
+
+__all__ = [
+    "TrainState", "Trainer", "evaluate", "load_checkpoint",
+    "make_eval_step", "make_train_step", "save_checkpoint", "shard_batch",
+]

@@ -1,0 +1,108 @@
+"""Checkpoint save/restore.
+
+Reference behavior (singlegpu.py:118-122; multigpu.py:109-113): pickle the
+model state_dict to one fixed relative path ``"checkpoint.pt"`` every
+``save_every`` epochs, silently overwriting, rank 0 only in multi — and no
+load path at all.  This module keeps the path/overwrite/rank-0 semantics but
+is a deliberate superset (required by BASELINE.json config #5, "checkpoint
+save/restore mid-run"): it also persists BN running stats, the SGD momentum
+buffers, and the global step/epoch counters, and provides ``load_checkpoint``
+so training can resume.
+
+Format: a single ``.npz`` of flat ``section/key/subkey`` arrays (our pytrees
+are all nested string-keyed dicts, so the flattening is lossless and the
+file is torch-free and inspectable with plain numpy).  Model keys mirror the
+reference's ``backbone.conv0.weight``-style naming from its ``add()`` helper
+(multigpu.py:45-47), as ``params/backbone/conv0/kernel``.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, NamedTuple
+
+import jax
+import numpy as np
+
+from ..optim.sgd import SGDState
+
+_SECTIONS = ("params", "batch_stats", "momentum")
+
+
+class Checkpoint(NamedTuple):
+    params: Dict[str, Any]
+    batch_stats: Dict[str, Any]
+    opt_state: SGDState
+    step: int
+    epoch: int
+
+
+# Nesting separator: "/" — model keys themselves may contain dots
+# (ResNet-18 uses "layer1.block0"-style names mirroring torchvision), so "."
+# would rebuild a different tree on load.
+_SEP = "/"
+
+
+def _flatten(tree: Any, prefix: str, out: Dict[str, np.ndarray]) -> None:
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            if _SEP in k:
+                raise ValueError(f"checkpoint key {k!r} contains {_SEP!r}")
+            _flatten(tree[k], f"{prefix}{_SEP}{k}" if prefix else k, out)
+    else:
+        out[prefix] = np.asarray(tree)
+
+
+def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    nested: Dict[str, Any] = {}
+    for key, val in flat.items():
+        node = nested
+        parts = key.split(_SEP)
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return nested
+
+
+def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
+                    step: int, epoch: int) -> None:
+    """Atomic overwrite-in-place write (the reference overwrites too,
+    multigpu.py:111 — atomically here so a preempted host never leaves a
+    torn file for the other hosts to restore)."""
+    flat: Dict[str, np.ndarray] = {}
+    for section, tree in zip(_SECTIONS,
+                             (params, batch_stats, opt_state.momentum_buf)):
+        sect_flat: Dict[str, np.ndarray] = {}
+        _flatten(jax.device_get(tree), "", sect_flat)
+        flat.update({f"{section}/{k}": v for k, v in sect_flat.items()})
+    flat["meta/step"] = np.asarray(int(step), np.int64)
+    flat["meta/epoch"] = np.asarray(int(epoch), np.int64)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **flat)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def load_checkpoint(path: str) -> Checkpoint:
+    """Restore everything ``save_checkpoint`` wrote (the path the reference
+    never built — SURVEY.md §3.4 'resume is absent')."""
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    sections: Dict[str, Dict[str, np.ndarray]] = {s: {} for s in _SECTIONS}
+    for key, val in flat.items():
+        section, _, rest = key.partition("/")
+        if section in sections:
+            sections[section][rest] = val
+    return Checkpoint(
+        params=_unflatten(sections["params"]),
+        batch_stats=_unflatten(sections["batch_stats"]),
+        opt_state=SGDState(_unflatten(sections["momentum"])),
+        step=int(flat["meta/step"]),
+        epoch=int(flat["meta/epoch"]),
+    )

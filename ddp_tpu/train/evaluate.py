@@ -1,0 +1,32 @@
+"""Evaluation — reference ``evaluate()`` (singlegpu.py:184-209 /
+multigpu.py:180-205): inference mode, full test-set pass, argmax accuracy %.
+
+Differences, both sanctioned by SURVEY.md (appendix): the test set is
+*sharded* over the mesh with ``psum``-ed correct/total counters instead of
+every rank redundantly scoring the whole set, and BN uses the replicated
+running stats (``model.eval()`` semantics, singlegpu.py:189).
+"""
+from __future__ import annotations
+
+import jax
+
+from .step import make_eval_step, shard_batch
+
+try:
+    from tqdm import tqdm  # the reference wraps eval in tqdm (singlegpu.py:194)
+except ImportError:  # pragma: no cover
+    def tqdm(x, **_):
+        return x
+
+
+def evaluate(model, params, batch_stats, loader, mesh, *,
+             compute_dtype=None, progress: bool = True) -> float:
+    """Accuracy in percent, as a Python float (reference singlegpu.py:205)."""
+    eval_step = make_eval_step(model, mesh, compute_dtype=compute_dtype)
+    correct = total = 0.0
+    batches = tqdm(loader, total=len(loader)) if progress else loader
+    for batch in batches:
+        c, t = eval_step(params, batch_stats, shard_batch(batch, mesh))
+        correct += float(c)
+        total += float(t)
+    return correct / max(total, 1.0) * 100.0

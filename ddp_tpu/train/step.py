@@ -1,0 +1,154 @@
+"""The jitted SPMD train/eval steps — the heart of the framework.
+
+The reference's ``Trainer._run_batch`` (singlegpu.py:102-108 /
+multigpu.py:92-98) is: zero_grad → forward → ``F.cross_entropy`` → backward
+(DDP fires a bucketed all-reduce-mean of gradients here, multigpu.py:96) →
+``optimizer.step()`` → ``scheduler.step()``.  Here the whole sequence is ONE
+jitted ``shard_map`` program over a 1-D ``data`` mesh:
+
+- batch sharded on ``data``; params / momentum replicated (DDP's replicas);
+- per-shard forward/backward — BatchNorm therefore uses *per-shard* batch
+  statistics, exactly the reference's unsynced-BN semantics (SyncBatchNorm
+  deliberately commented out at multigpu.py:127).  This is why the step uses
+  ``shard_map`` rather than GSPMD-jit sharding constraints: under plain jit
+  XLA computes BN statistics over the *global* batch, which would silently
+  be sync-BN (SURVEY.md §7 hard-part #2);
+- ``lax.pmean`` on gradients == DDP's all-reduce(mean); XLA lowers it to an
+  ICI all-reduce and owns the overlap/scheduling DDP does with buckets;
+- SGD + momentum update applied to the replicated params inside the same
+  program (identical update per replica keeps them in lockstep, the same
+  invariant DDP relies on at multigpu.py:97);
+- the per-batch LR is passed in as a traced scalar so the per-step schedule
+  (scheduler.step() per batch, singlegpu.py:108) never recompiles.
+
+Running BN buffers are ``pmean``-ed across shards before being returned —
+a deliberate, documented deviation: the reference keeps per-rank buffers and
+checkpoints rank 0's (multigpu.py:110); averaging is statistically at least
+as good and keeps the returned state replicated.  Training-time
+normalisation is unaffected (it uses batch stats).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..optim import sgd as sgd_lib
+from ..ops.losses import cross_entropy_sum_count
+from ..parallel.mesh import DATA_AXIS, batch_sharding, replicated_sharding
+
+
+class TrainState(NamedTuple):
+    """Everything that evolves across steps, as one replicated pytree."""
+    params: Any
+    batch_stats: Any
+    opt_state: sgd_lib.SGDState
+    step: jax.Array  # int32 global batch index (drives the LR schedule)
+
+
+def init_train_state(params, batch_stats) -> TrainState:
+    return TrainState(params, batch_stats, sgd_lib.init(params),
+                      jnp.zeros((), jnp.int32))
+
+
+def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
+                    lr_schedule: Callable[[jax.Array], jax.Array],
+                    mesh: Mesh, compute_dtype=None):
+    """Build the jitted SPMD train step for ``model`` over ``mesh``.
+
+    Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
+    is ``{"image": f32[B,H,W,C], "label": i32[B]}`` with B divisible by the
+    mesh size, globally sharded on ``data``.  ``rng`` feeds dropout (DeepNN,
+    singlegpu.py:36); models without dropout ignore it.
+    """
+
+    def _shard_body(state: TrainState, batch, rng):
+        # Per-step, per-shard RNG so dropout masks differ across steps and
+        # across replicas' data shards; the caller passes one constant key.
+        rng = jax.random.fold_in(rng, state.step)
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+
+        def loss_fn(params):
+            logits, new_stats = model.apply(
+                params, state.batch_stats, batch["image"], train=True,
+                rng=rng, compute_dtype=compute_dtype)
+            ce_sum, count = cross_entropy_sum_count(logits, batch["label"])
+            # Global mean: psum(sum)/psum(count).  Equal per-shard counts
+            # (DistributedSampler padding guarantee, multigpu.py:153) make
+            # this identical to DDP's mean-of-rank-means.
+            loss = (lax.psum(ce_sum, DATA_AXIS)
+                    / lax.psum(count, DATA_AXIS))
+            return loss, new_stats
+
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        # No explicit gradient collective: differentiating w.r.t. the
+        # replicated (in_specs=P()) params makes shard_map's autodiff insert
+        # the psum over ``data`` itself (the transpose of replication —
+        # jax>=0.9 vma semantics).  That auto-psum of the global-mean loss
+        # IS DDP's bucketed all-reduce(mean) (multigpu.py:96); an explicit
+        # pmean here would double-count by the mesh size
+        # (tests/test_train_step.py pins this numerically).
+        new_stats = jax.tree_util.tree_map(
+            lambda s: lax.pmean(s, DATA_AXIS), new_stats)
+        lr_t = lr_schedule(state.step)
+        params, opt_state = sgd_lib.apply_updates(
+            state.params, grads, state.opt_state, lr_t, sgd_config)
+        return TrainState(params, new_stats, opt_state, state.step + 1), loss
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(P(), {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
+        out_specs=(P(), P()),
+    )
+    rep = replicated_sharding(mesh)
+    return jax.jit(mapped, donate_argnums=(0,),
+                   out_shardings=(rep, rep))
+
+
+def make_eval_step(model, mesh: Mesh, compute_dtype=None):
+    """Sharded evaluation step: global (correct, total) via ``psum``.
+
+    The reference redundantly evaluates the full test set on every rank
+    (multigpu.py:247, SURVEY.md §3.5); here each shard scores its slice and
+    the counters are summed over ICI — same result, 1/N the work.  ``mask``
+    zeroes the padding rows that keep shapes static (test set size need not
+    divide the mesh).
+    """
+
+    def _shard_body(params, batch_stats, batch):
+        logits, _ = model.apply(params, batch_stats, batch["image"],
+                                train=False, compute_dtype=compute_dtype)
+        pred = jnp.argmax(logits, axis=-1)
+        maskf = batch["mask"].astype(jnp.float32)
+        correct = ((pred == batch["label"]).astype(jnp.float32) * maskf).sum()
+        total = maskf.sum()
+        return (lax.psum(correct, DATA_AXIS), lax.psum(total, DATA_AXIS))
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(P(), P(), {"image": P(DATA_AXIS), "label": P(DATA_AXIS),
+                             "mask": P(DATA_AXIS)}),
+        out_specs=(P(), P()),
+    )
+    rep = replicated_sharding(mesh)
+    return jax.jit(mapped, out_shardings=(rep, rep))
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    """Host numpy batch -> global device array sharded on ``data``.
+
+    Single-host: a plain ``device_put`` split.  Multi-host: each process
+    holds only its local slice (the per-host shard the sampler produced) and
+    the global array is assembled from process-local data — the analogue of
+    each DDP rank feeding its own DistributedSampler shard.
+    """
+    sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return {k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()}

@@ -1,0 +1,3 @@
+from .model_size import Byte, GiB, KiB, MiB, count_params, get_model_size
+
+__all__ = ["Byte", "GiB", "KiB", "MiB", "count_params", "get_model_size"]

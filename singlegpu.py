@@ -1,0 +1,13 @@
+"""Single-chip training — the reference ``singlegpu.py`` entry point
+(singlegpu.py:254-263), same argv:
+
+    python singlegpu.py <total_epochs> <save_every> [--batch_size N]
+
+On TPU the single-device path is just a mesh of one chip running the same
+jitted train step as the distributed path (SURVEY.md §7 design stance).
+"""
+from ddp_tpu.cli import build_parser, run
+
+if __name__ == "__main__":
+    args = build_parser("single-device distributed training job").parse_args()
+    run(args, num_devices=1)

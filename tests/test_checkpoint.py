@@ -1,0 +1,95 @@
+"""Checkpoint save/restore — the superset of the reference's save-only path
+(singlegpu.py:118-122; resume required by BASELINE.json config #5)."""
+import functools
+import os
+
+import jax
+import numpy as np
+
+from ddp_tpu.data import TrainLoader, synthetic
+from ddp_tpu.models import get_model
+from ddp_tpu.optim import SGDConfig, triangular_lr
+from ddp_tpu.parallel import make_mesh
+from ddp_tpu.train import Trainer, load_checkpoint, save_checkpoint
+from ddp_tpu.train.step import init_train_state
+
+
+import pytest
+
+
+@pytest.mark.parametrize("name", ["vgg", "resnet18"])
+def test_roundtrip_all_models(tmp_path, name):
+    """resnet18 keys contain dots ('layer1.block0'), which must survive the
+    flatten/unflatten round trip."""
+    model = get_model(name)
+    params, stats = model.init(jax.random.key(0))
+    state = init_train_state(params, stats)
+    path = str(tmp_path / "ck.pt")
+    save_checkpoint(path, state.params, state.batch_stats, state.opt_state,
+                    step=1, epoch=0)
+    ck = load_checkpoint(path)
+    assert (jax.tree_util.tree_structure(ck.params)
+            == jax.tree_util.tree_structure(jax.device_get(state.params)))
+    assert (jax.tree_util.tree_structure(ck.batch_stats)
+            == jax.tree_util.tree_structure(
+                jax.device_get(state.batch_stats)))
+
+
+def test_roundtrip(tmp_path):
+    model = get_model("vgg")
+    params, stats = model.init(jax.random.key(0))
+    state = init_train_state(params, stats)
+    path = str(tmp_path / "ck.pt")
+    save_checkpoint(path, state.params, state.batch_stats, state.opt_state,
+                    step=7, epoch=3)
+    ck = load_checkpoint(path)
+    assert ck.step == 7 and ck.epoch == 3
+    for (pw, w), (pg, g) in zip(
+            jax.tree_util.tree_leaves_with_path(jax.device_get(state.params)),
+            jax.tree_util.tree_leaves_with_path(ck.params)):
+        assert pw == pg
+        np.testing.assert_array_equal(np.asarray(w), g)
+    # Momentum buffers restored with the same tree structure.
+    assert (jax.tree_util.tree_structure(ck.opt_state.momentum_buf)
+            == jax.tree_util.tree_structure(
+                jax.device_get(state.opt_state.momentum_buf)))
+
+
+def _make_trainer(path, epochs, seed=0, resume=False):
+    train_ds, _ = synthetic(n_train=256, seed=1)
+    mesh = make_mesh(8)
+    model = get_model("vgg")
+    params, stats = model.init(jax.random.key(seed))
+    loader = TrainLoader(train_ds, per_replica_batch=8, num_replicas=8,
+                         seed=seed)
+    sched = functools.partial(triangular_lr, base_lr=0.05, num_epochs=epochs,
+                              steps_per_epoch=len(loader))
+    return Trainer(model, loader, params, stats, mesh=mesh, lr_schedule=sched,
+                   sgd_config=SGDConfig(lr=0.05), save_every=1,
+                   snapshot_path=path, resume=resume)
+
+
+def test_resume_continues_exactly(tmp_path):
+    """train(2 epochs) == train(1 epoch) -> restart -> train(2nd epoch):
+    resumed params/momentum/step must reproduce the uninterrupted run
+    bit-for-bit (the restore path the reference lacks, SURVEY.md §3.4)."""
+    p_full = str(tmp_path / "full.pt")
+    p_half = str(tmp_path / "half.pt")
+
+    t_full = _make_trainer(p_full, epochs=2)
+    t_full.train(2)
+
+    t_half = _make_trainer(p_half, epochs=2)
+    t_half.train(1)
+    assert os.path.exists(p_half)
+    t_res = _make_trainer(p_half, epochs=2, resume=True)
+    assert t_res.start_epoch == 1
+    t_res.train(2)
+
+    a = jax.device_get(t_full.state.params)
+    b = jax.device_get(t_res.state.params)
+    for (pa, x), (pb, y) in zip(jax.tree_util.tree_leaves_with_path(a),
+                                jax.tree_util.tree_leaves_with_path(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=str(pa))
+    assert int(t_full.state.step) == int(t_res.state.step)
