@@ -30,9 +30,11 @@ from ddp_tpu.parallel import make_mesh
 from ddp_tpu.train import make_train_step, shard_batch
 from ddp_tpu.train.step import init_train_state
 
-# Recorded fp32 samples/sec/chip from earlier rounds on the driver's TPU
-# (None until a first real-TPU number exists to compare against).
-BASELINE_BENCH = None
+# Recorded fp32 samples/sec/chip from round 1 on the driver's TPU (v5e,
+# batch 512, 30 timed steps) — the reference publishes no numbers
+# (SURVEY.md §6), so later rounds compare against this framework's own
+# first measurement.
+BASELINE_BENCH = 22897.0
 
 
 def main() -> None:
@@ -64,11 +66,14 @@ def main() -> None:
     # At least one warmup step always runs (it also triggers compilation).
     for _ in range(max(args.warmup, 1)):
         state, loss = step_fn(state, batch, rng)
-    jax.block_until_ready(loss)
+    float(loss)  # full sync: device->host read of the dependency chain's end
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = step_fn(state, batch, rng)
-    jax.block_until_ready(loss)
+    # Sync via a host read of the last loss, which depends on every step.
+    # (block_until_ready alone has been observed to return early through
+    # remote-device tunnels; a value read cannot.)
+    float(loss)
     dt = time.perf_counter() - t0
 
     sps_chip = global_batch * args.steps / dt / n_chips
