@@ -27,6 +27,7 @@ from .optim import SGDConfig, triangular_lr
 from .parallel import dist, make_mesh
 from .train import Trainer, evaluate
 from .utils import MiB, get_model_size
+from .utils.metrics import MetricsLogger
 
 
 def build_parser(description: str) -> argparse.ArgumentParser:
@@ -57,6 +58,13 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size override (default: entry-point specific)")
+    p.add_argument("--metrics_path", default=None,
+                   help="Append per-step {step, epoch, loss, lr, wall_s} "
+                        "JSON lines here (the loss stream the reference "
+                        "lacks, SURVEY.md section 5)")
+    p.add_argument("--profile_dir", default=None,
+                   help="Capture a jax.profiler trace of the training loop "
+                        "into this directory (view with TensorBoard)")
     return p
 
 
@@ -93,16 +101,22 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         triangular_lr, base_lr=args.lr, num_epochs=args.total_epochs,
         steps_per_epoch=len(train_loader))
 
+    metrics = MetricsLogger(args.metrics_path)
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
                       lr_schedule=lr_schedule, sgd_config=SGDConfig(lr=args.lr),
                       save_every=args.save_every,
                       snapshot_path=args.snapshot_path,
                       compute_dtype=compute_dtype, seed=args.seed,
-                      resume=args.resume)
+                      resume=args.resume, metrics=metrics)
 
     start = time.time()
+    if args.profile_dir:
+        jax.profiler.start_trace(args.profile_dir)
     trainer.train(args.total_epochs)
+    if args.profile_dir:
+        jax.profiler.stop_trace()
     training_time = time.time() - start
+    metrics.close()
     # Reference report block (multigpu.py:230-248).
     print(f"Total training time: {training_time:.2f} seconds")
     fp32_model_size = get_model_size(trainer.state.params, 32)

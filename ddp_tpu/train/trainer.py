@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from ..optim.sgd import SGDConfig
 from ..parallel import dist
+from ..utils.metrics import MetricsLogger
 from .checkpoint import load_checkpoint, save_checkpoint
 from .step import TrainState, init_train_state, make_train_step, shard_batch
 
@@ -40,13 +41,18 @@ class Trainer:
                  save_every: int = 1,
                  snapshot_path: str = "checkpoint.pt",
                  compute_dtype=None, seed: int = 0,
-                 resume: bool = False):
+                 resume: bool = False,
+                 metrics: Optional[MetricsLogger] = None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
         self.save_every = save_every
         self.snapshot_path = snapshot_path
         self.gpu_id = dist.process_index()  # reference's rank handle
+        self.lr_schedule = lr_schedule
+        # Per-step loss/LR stream (absent in the reference — SURVEY.md §5
+        # flags it as required for loss-curve parity measurement).
+        self.metrics = metrics if self.gpu_id == 0 else None
         self.rng = jax.random.key(seed)
         self.loss_history: List[float] = []
         self.start_epoch = 0
@@ -83,7 +89,16 @@ class Trainer:
                 self.state, device_batch, self.rng)
         if pending is not None:
             epoch_losses.append(pending)
-        self.loss_history.extend(float(l) for l in epoch_losses)
+        start_step = int(self.state.step) - len(epoch_losses)
+        losses = [float(l) for l in epoch_losses]
+        self.loss_history.extend(losses)
+        if self.metrics is not None and losses:
+            # One vectorised device eval of the schedule per epoch.
+            lrs = jax.device_get(jax.vmap(self.lr_schedule)(
+                jnp.arange(start_step, start_step + len(losses))))
+            for i, (loss, lr) in enumerate(zip(losses, lrs)):
+                self.metrics.log_step(step=start_step + i, epoch=epoch,
+                                      loss=loss, lr=float(lr))
 
     def _save_checkpoint(self, epoch: int) -> None:
         save_checkpoint(self.snapshot_path, self.state.params,

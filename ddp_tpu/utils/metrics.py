@@ -1,0 +1,46 @@
+"""Per-step metrics logging.
+
+The reference never logs training loss (SURVEY.md §5: its only telemetry is
+the epoch-header print, multigpu.py:102, and end-of-run wall-clock/size/
+accuracy prints) — but loss-curve parity can't be measured without a loss
+stream, so the survey flags per-step loss emission as a required addition.
+
+``MetricsLogger`` appends one JSON line per step: global step, epoch, loss,
+effective LR, wall-clock seconds since construction.  Process-0 only (the
+same gate as checkpoint writes, multigpu.py:118) — values are replicated
+across the mesh, so one writer suffices.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import IO, Optional
+
+
+class MetricsLogger:
+    def __init__(self, path: Optional[str], enabled: bool = True):
+        self.path = path
+        self._f: Optional[IO[str]] = None
+        self._t0 = time.time()
+        if path and enabled:
+            self._f = open(path, "a", buffering=1)  # line-buffered
+
+    def log_step(self, *, step: int, epoch: int, loss: float,
+                 lr: float) -> None:
+        if self._f is None:
+            return
+        self._f.write(json.dumps({
+            "step": step, "epoch": epoch, "loss": round(loss, 6),
+            "lr": round(lr, 8), "wall_s": round(time.time() - self._t0, 3),
+        }) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
