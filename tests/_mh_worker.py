@@ -1,0 +1,50 @@
+"""Worker process for tests/test_multihost.py: one of two 'hosts' (4 CPU
+devices each) driving the REAL framework path — ``jax.distributed``
+rendezvous, per-host ``TrainLoader`` slice, ``make_array_from_process_local_
+data`` batch assembly, shard_map train step, process-0 checkpoint write.
+
+Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path>
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    pid, coordinator, ckpt_path = (int(sys.argv[1]), sys.argv[2], sys.argv[3])
+    from ddp_tpu.parallel import dist
+    dist.initialize(coordinator=coordinator, num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and jax.device_count() == 8
+
+    import functools
+    from ddp_tpu.data import TrainLoader, synthetic
+    from ddp_tpu.models import get_model
+    from ddp_tpu.optim import SGDConfig, triangular_lr
+    from ddp_tpu.parallel import make_mesh
+    from ddp_tpu.train import Trainer
+
+    mesh = make_mesh()  # all 8 devices across both processes
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    train_ds, _ = synthetic(n_train=128, seed=5)
+    ldc = jax.local_device_count()
+    local = range(pid * ldc, pid * ldc + ldc)
+    loader = TrainLoader(train_ds, per_replica_batch=4, num_replicas=8,
+                         augment=False, seed=7, local_replicas=local)
+    sched = functools.partial(triangular_lr, base_lr=0.1, num_epochs=2,
+                              steps_per_epoch=len(loader))
+    trainer = Trainer(model, loader, params, stats, mesh=mesh,
+                      lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
+                      save_every=1, snapshot_path=ckpt_path)
+    trainer.train(2)  # process 0 writes the checkpoint (rank-0 gate)
+    dist.shutdown()
+
+
+if __name__ == "__main__":
+    main()
