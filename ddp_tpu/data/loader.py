@@ -22,7 +22,7 @@ from typing import Dict, Iterator, Optional
 
 import numpy as np
 
-from .augment import random_crop_flip, to_float
+from .augment import random_crop_flip
 from .cifar10 import Dataset
 from .sampler import DistributedShardSampler, ShuffleSampler
 
@@ -65,21 +65,36 @@ class TrainLoader:
         self.epoch = epoch
         for s in self.samplers:
             s.set_epoch(epoch)
+        self._shards = None  # recomputed lazily for the new epoch
 
     def __len__(self) -> int:
         return self.steps_per_epoch
 
-    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
-        shards = [s.indices() for s in self.samplers]
-        rng = np.random.default_rng((self.seed, self.epoch, 0x5EED))
+    def _epoch_shards(self):
+        if getattr(self, "_shards", None) is None:
+            self._shards = [s.indices() for s in self.samplers]
+        return self._shards
+
+    def materialize(self, k: int) -> Dict[str, np.ndarray]:
+        """Build global batch ``k`` of the current epoch.  Thread-safe and
+        order-independent: the augmentation RNG is keyed (seed, epoch, k),
+        so a prefetch pool can build batches concurrently and still be
+        deterministic.  (The reference's torchvision transforms draw from
+        one global torch RNG stream — per-batch keying preserves the
+        distribution, which is what the loss curve depends on.)"""
+        shards = self._epoch_shards()
         b = self.per_replica_batch
-        for k in range(self.steps_per_epoch):
-            idx = np.concatenate([sh[k * b:(k + 1) * b] for sh in shards])
-            imgs = self.dataset.images[idx]
-            if self.augment:
-                imgs = random_crop_flip(imgs, rng)
-            yield {"image": to_float(imgs),
-                   "label": self.dataset.labels[idx]}
+        idx = np.concatenate([sh[k * b:(k + 1) * b] for sh in shards])
+        imgs = self.dataset.images[idx]
+        if self.augment:
+            rng = np.random.default_rng((self.seed, self.epoch, k, 0x5EED))
+            imgs = random_crop_flip(imgs, rng)
+        # uint8 on the wire; ToTensor scaling happens on device
+        # (train.step._as_input) at 1/4 the transfer bytes.
+        return {"image": imgs, "label": self.dataset.labels[idx]}
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return (self.materialize(k) for k in range(self.steps_per_epoch))
 
 
 class EvalLoader:
@@ -120,4 +135,4 @@ class EvalLoader:
                 rows = np.concatenate([np.arange(r * per, (r + 1) * per)
                                        for r in self.local_replicas])
                 imgs, labels, mask = imgs[rows], labels[rows], mask[rows]
-            yield {"image": to_float(imgs), "label": labels, "mask": mask}
+            yield {"image": imgs, "label": labels, "mask": mask}

@@ -1,0 +1,70 @@
+"""Background host->device prefetch.
+
+The reference hides input-pipeline latency with ``pin_memory=True`` +
+DataLoader worker processes (singlegpu.py:177); the TPU analogue here is a
+thread pool that materialises (gather + augment) upcoming batches
+concurrently, plus a device_put one step ahead of consumption.  Loaders
+exposing ``materialize(k)`` (order-independent, per-batch-seeded —
+``TrainLoader``) get true parallel workers; any other batch iterable falls
+back to a single pipelining thread.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterable, Iterator
+
+import numpy as np
+
+from ..train.step import shard_batch
+
+_DONE = object()
+
+
+def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,
+                       depth: int = 2, workers: int = 4) -> Iterator[dict]:
+    """Yield device-resident, data-sharded batches ahead of consumption."""
+    if hasattr(batches, "materialize") and hasattr(batches, "__len__"):
+        yield from _pooled(batches, mesh, depth, workers)
+    else:
+        yield from _threaded(iter(batches), mesh, depth)
+
+
+def _pooled(loader, mesh, depth: int, workers: int) -> Iterator[dict]:
+    n = len(loader)
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futures = deque(pool.submit(loader.materialize, k)
+                        for k in range(min(workers + depth, n)))
+        next_k = len(futures)
+        while futures:
+            batch = futures.popleft().result()
+            if next_k < n:
+                futures.append(pool.submit(loader.materialize, next_k))
+                next_k += 1
+            yield shard_batch(batch, mesh)
+
+
+def _threaded(batches: Iterator[Dict[str, np.ndarray]], mesh,
+              depth: int) -> Iterator[dict]:
+    q: queue.Queue = queue.Queue(maxsize=depth)
+
+    def worker() -> None:
+        try:
+            for batch in batches:
+                q.put(shard_batch(batch, mesh))
+        except BaseException as e:  # surfaced in the consumer thread
+            q.put(("__error__", e))
+            return
+        q.put(_DONE)
+
+    threading.Thread(target=worker, daemon=True).start()
+    while True:
+        item = q.get()
+        if item is _DONE:
+            return
+        if isinstance(item, tuple) and len(item) == 2 \
+                and item[0] == "__error__":
+            raise item[1]
+        yield item

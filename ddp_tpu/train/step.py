@@ -42,6 +42,16 @@ from ..ops.losses import cross_entropy_sum_count
 from ..parallel.mesh import DATA_AXIS, batch_sharding, replicated_sharding
 
 
+def _as_input(x: jax.Array, compute_dtype=None) -> jax.Array:
+    """Accept uint8 batches and apply ToTensor scaling (u8/255,
+    singlegpu.py:158) on DEVICE: the loaders ship uint8 so each batch
+    crosses the host->device link at 1/4 the bytes of fp32 — the transfer,
+    not the chips, is the bottleneck on thin links."""
+    if x.dtype == jnp.uint8:
+        return x.astype(compute_dtype or jnp.float32) / 255.0
+    return x
+
+
 class TrainState(NamedTuple):
     """Everything that evolves across steps, as one replicated pytree."""
     params: Any
@@ -74,7 +84,8 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
 
         def loss_fn(params):
             logits, new_stats = model.apply(
-                params, state.batch_stats, batch["image"], train=True,
+                params, state.batch_stats,
+                _as_input(batch["image"], compute_dtype), train=True,
                 rng=rng, compute_dtype=compute_dtype)
             ce_sum, count = cross_entropy_sum_count(logits, batch["label"])
             # Global mean: psum(sum)/psum(count).  Equal per-shard counts
@@ -121,7 +132,8 @@ def make_eval_step(model, mesh: Mesh, compute_dtype=None):
     """
 
     def _shard_body(params, batch_stats, batch):
-        logits, _ = model.apply(params, batch_stats, batch["image"],
+        logits, _ = model.apply(params, batch_stats,
+                                _as_input(batch["image"], compute_dtype),
                                 train=False, compute_dtype=compute_dtype)
         pred = jnp.argmax(logits, axis=-1)
         maskf = batch["mask"].astype(jnp.float32)

@@ -26,6 +26,7 @@ from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..optim.sgd import SGDConfig
 from ..parallel import dist
@@ -39,7 +40,7 @@ class Trainer:
                  mesh, lr_schedule: Callable,
                  sgd_config: SGDConfig = SGDConfig(),
                  save_every: int = 1,
-                 snapshot_path: str = "checkpoint.pt",
+                 snapshot_path: Optional[str] = "checkpoint.pt",
                  compute_dtype=None, seed: int = 0,
                  resume: bool = False,
                  metrics: Optional[MetricsLogger] = None):
@@ -78,19 +79,19 @@ class Trainer:
               f"Steps: {len(self.train_loader)}")
         self.train_loader.set_epoch(epoch)
         epoch_losses = []
-        pending = None
-        for batch in self.train_loader:
-            device_batch = shard_batch(batch, self.mesh)
-            if pending is not None:
-                epoch_losses.append(pending)
-            # Async dispatch: returns immediately; the host loop augments
-            # the next batch while the chips run this step.
-            self.state, pending = self.train_step(
+        # Background thread augments + device_puts ahead of the loop (the
+        # pin_memory/worker analogue, singlegpu.py:177); combined with JAX
+        # async dispatch the chips never wait on the host in steady state.
+        from ..data.prefetch import prefetch_to_device
+        for device_batch in prefetch_to_device(self.train_loader, self.mesh):
+            self.state, loss = self.train_step(
                 self.state, device_batch, self.rng)
-        if pending is not None:
-            epoch_losses.append(pending)
+            epoch_losses.append(loss)
         start_step = int(self.state.step) - len(epoch_losses)
-        losses = [float(l) for l in epoch_losses]
+        # One stacked D2H transfer for the whole epoch's losses — per-scalar
+        # reads pay a link round trip each on remote-device setups.
+        losses = (np.asarray(jax.device_get(jnp.stack(epoch_losses))).tolist()
+                  if epoch_losses else [])
         self.loss_history.extend(losses)
         if self.metrics is not None and losses:
             # One vectorised device eval of the schedule per epoch.
@@ -113,5 +114,8 @@ class Trainer:
         the rank-0 ``save_every`` checkpoint gate."""
         for epoch in range(self.start_epoch, max_epochs):
             self._run_epoch(epoch)
-            if self.gpu_id == 0 and epoch % self.save_every == 0:
+            # NB: like the reference, epoch 0 satisfies the modulo gate —
+            # snapshot_path=None disables checkpointing entirely.
+            if (self.snapshot_path and self.gpu_id == 0
+                    and epoch % self.save_every == 0):
                 self._save_checkpoint(epoch)
