@@ -65,6 +65,9 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--profile_dir", default=None,
                    help="Capture a jax.profiler trace of the training loop "
                         "into this directory (view with TensorBoard)")
+    p.add_argument("--device_augment", action="store_true",
+                   help="Run RandomCrop+HFlip on the TPU inside the train "
+                        "step instead of on the host (same distribution)")
     return p
 
 
@@ -92,7 +95,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     local_replicas = range(jax.process_index() * ldc,
                            jax.process_index() * ldc + ldc)
     train_loader = TrainLoader(train_ds, args.batch_size, n_replicas,
-                               seed=args.seed, local_replicas=local_replicas)
+                               seed=args.seed, local_replicas=local_replicas,
+                               augment=not args.device_augment)
     # Triangular schedule (reference singlegpu.py:142-149) with
     # steps_per_epoch derived from the real shard size and the triangle span
     # tied to the CLI epoch count — the two sanctioned fixes to the
@@ -107,7 +111,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       save_every=args.save_every,
                       snapshot_path=args.snapshot_path,
                       compute_dtype=compute_dtype, seed=args.seed,
-                      resume=args.resume, metrics=metrics)
+                      resume=args.resume, metrics=metrics,
+                      device_augment=args.device_augment)
 
     start = time.time()
     if args.profile_dir:

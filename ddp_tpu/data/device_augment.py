@@ -1,0 +1,37 @@
+"""On-device train-time augmentation (RandomCrop(32, pad 4) + HFlip).
+
+TPU-first alternative to the host-side ``augment.py`` path: raw uint8
+batches go over the host->device link and the crop/flip happens inside the
+jitted train step — per-image dynamic slices and a reversed ``where``, both
+trivially fused by XLA.  At pod scale the host augmentation thread pool is
+the classic input bottleneck (SURVEY.md §7 hard-part #4); on device the cost
+is noise next to the convolutions.
+
+Distributional parity with torchvision's transforms (singlegpu.py:154-160):
+offsets uniform over [0, 8], flip probability 0.5, zero padding.  The
+concrete RNG stream differs (JAX threefry vs torch Philox vs numpy PCG64) —
+as with the samplers, only the distribution is load-bearing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PAD = 4
+SIZE = 32
+
+
+def random_crop_flip(rng: jax.Array, imgs: jax.Array) -> jax.Array:
+    """[N,32,32,3] (any dtype) -> same shape/dtype, cropped+flipped."""
+    n = imgs.shape[0]
+    k_off, k_flip = jax.random.split(rng)
+    ys, xs = jax.random.randint(k_off, (2, n), 0, 2 * PAD + 1)
+    flip = jax.random.bernoulli(k_flip, 0.5, (n,))
+    padded = jnp.pad(imgs, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
+
+    def crop_one(img, y, x):
+        return lax.dynamic_slice(img, (y, x, 0), (SIZE, SIZE, img.shape[-1]))
+
+    out = jax.vmap(crop_one)(padded, ys, xs)
+    return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)

@@ -67,13 +67,16 @@ def init_train_state(params, batch_stats) -> TrainState:
 
 def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
-                    mesh: Mesh, compute_dtype=None):
+                    mesh: Mesh, compute_dtype=None,
+                    device_augment: bool = False):
     """Build the jitted SPMD train step for ``model`` over ``mesh``.
 
     Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
-    is ``{"image": f32[B,H,W,C], "label": i32[B]}`` with B divisible by the
-    mesh size, globally sharded on ``data``.  ``rng`` feeds dropout (DeepNN,
-    singlegpu.py:36); models without dropout ignore it.
+    is ``{"image": u8|f32[B,H,W,C], "label": i32[B]}`` with B divisible by
+    the mesh size, globally sharded on ``data``.  ``rng`` feeds dropout
+    (DeepNN, singlegpu.py:36) and, with ``device_augment=True``, the
+    on-device RandomCrop+HFlip (data/device_augment.py) — in that mode the
+    loader must be built with ``augment=False``.
     """
 
     def _shard_body(state: TrainState, batch, rng):
@@ -81,11 +84,15 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
         # across replicas' data shards; the caller passes one constant key.
         rng = jax.random.fold_in(rng, state.step)
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        images = batch["image"]
+        if device_augment:
+            from ..data.device_augment import random_crop_flip
+            images = random_crop_flip(jax.random.fold_in(rng, 1), images)
 
         def loss_fn(params):
             logits, new_stats = model.apply(
                 params, state.batch_stats,
-                _as_input(batch["image"], compute_dtype), train=True,
+                _as_input(images, compute_dtype), train=True,
                 rng=rng, compute_dtype=compute_dtype)
             ce_sum, count = cross_entropy_sum_count(logits, batch["label"])
             # Global mean: psum(sum)/psum(count).  Equal per-shard counts

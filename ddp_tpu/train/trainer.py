@@ -43,7 +43,8 @@ class Trainer:
                  snapshot_path: Optional[str] = "checkpoint.pt",
                  compute_dtype=None, seed: int = 0,
                  resume: bool = False,
-                 metrics: Optional[MetricsLogger] = None):
+                 metrics: Optional[MetricsLogger] = None,
+                 device_augment: bool = False):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -69,7 +70,7 @@ class Trainer:
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
         self.train_step = make_train_step(
             model, sgd_config, lr_schedule, mesh,
-            compute_dtype=compute_dtype)
+            compute_dtype=compute_dtype, device_augment=device_augment)
 
     def _run_epoch(self, epoch: int) -> None:
         b_sz = self.train_loader.per_replica_batch

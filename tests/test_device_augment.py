@@ -1,0 +1,65 @@
+"""On-device RandomCrop+HFlip: semantics match the host/torchvision
+behavior distributionally (zero padding, uniform offsets, p=0.5 flip)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_tpu.data.device_augment import random_crop_flip
+
+
+def _batch(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 255, (n, 32, 32, 3)).astype(np.uint8)
+
+
+def test_output_rows_come_from_padded_input():
+    """Every output image must be a contiguous 32x32 window of the
+    zero-padded input (possibly h-flipped)."""
+    imgs = _batch(32)
+    out = np.asarray(random_crop_flip(jax.random.key(0), jnp.asarray(imgs)))
+    assert out.shape == imgs.shape and out.dtype == np.uint8
+    padded = np.pad(imgs, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    for i in range(len(imgs)):
+        found = False
+        for y in range(9):
+            for x in range(9):
+                win = padded[i, y:y + 32, x:x + 32]
+                if np.array_equal(out[i], win) or \
+                        np.array_equal(out[i], win[:, ::-1]):
+                    found = True
+                    break
+            if found:
+                break
+        assert found, f"image {i} is not a crop/flip of its input"
+
+
+def test_flip_rate_and_offset_spread():
+    imgs = _batch(512, seed=1)
+    out = np.asarray(random_crop_flip(jax.random.key(1), jnp.asarray(imgs)))
+    padded = np.pad(imgs, ((0, 0), (4, 4), (4, 4), (0, 0)))
+    flips = 0
+    offsets = set()
+    for i in range(len(imgs)):
+        for y in range(9):
+            for x in range(9):
+                win = padded[i, y:y + 32, x:x + 32]
+                if np.array_equal(out[i], win):
+                    offsets.add((y, x))
+                    break
+                if np.array_equal(out[i], win[:, ::-1]):
+                    flips += 1
+                    offsets.add((y, x))
+                    break
+            else:
+                continue
+            break
+    # ~50% flips (binomial n=512), offsets cover most of the 9x9 grid.
+    assert 0.4 < flips / len(imgs) < 0.6
+    assert len(offsets) > 40
+
+
+def test_deterministic_given_key():
+    imgs = jnp.asarray(_batch(16))
+    a = random_crop_flip(jax.random.key(7), imgs)
+    b = random_crop_flip(jax.random.key(7), imgs)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
