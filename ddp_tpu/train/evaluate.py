@@ -19,10 +19,17 @@ except ImportError:  # pragma: no cover
         return x
 
 
+_step_cache: dict = {}
+
+
 def evaluate(model, params, batch_stats, loader, mesh, *,
              compute_dtype=None, progress: bool = True) -> float:
     """Accuracy in percent, as a Python float (reference singlegpu.py:205)."""
-    eval_step = make_eval_step(model, mesh, compute_dtype=compute_dtype)
+    key = (model, mesh, compute_dtype)  # ModelDef is a hashable NamedTuple
+    eval_step = _step_cache.get(key)
+    if eval_step is None:
+        eval_step = _step_cache[key] = make_eval_step(
+            model, mesh, compute_dtype=compute_dtype)
     correct = total = 0.0
     batches = tqdm(loader, total=len(loader)) if progress else loader
     for batch in batches:
