@@ -1,0 +1,62 @@
+"""CLI extensions: torch-checkpoint import, schedule override flags, and
+the driver entry hooks."""
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from ddp_tpu import cli
+from ddp_tpu.optim import triangular_lr
+from tests.torch_ref import TorchVGG
+
+
+def test_init_from_torch_checkpoint(tmp_path, capsys, monkeypatch):
+    """A reference-produced state_dict checkpoint initialises training —
+    the migration path for reference users (keys from multigpu.py:45-47)."""
+    monkeypatch.chdir(tmp_path)
+    torch.manual_seed(0)
+    ckpt = tmp_path / "torch_checkpoint.pt"
+    torch.save(TorchVGG().state_dict(), str(ckpt))
+
+    args = cli.build_parser("t").parse_args(
+        ["1", "1", "--batch_size", "8", "--synthetic", "--lr", "0.01",
+         "--num_devices", "8", "--init_from_torch", str(ckpt)])
+    acc = cli.run(args, num_devices=None)
+    assert 0.0 <= acc <= 100.0
+    out = capsys.readouterr().out
+    assert "fp32 model has size=35.20 MiB" in out
+
+
+def test_schedule_override_reproduces_reference_curve():
+    """--schedule_epochs/--schedule_steps_per_epoch pin the reference's
+    hardcoded triangle (98 steps/epoch, 20 epochs — singlegpu.py:142-149)
+    regardless of the real shard size."""
+    ref = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
+                            steps_per_epoch=98)
+    args = cli.build_parser("t").parse_args(
+        ["5", "1", "--schedule_epochs", "20",
+         "--schedule_steps_per_epoch", "98"])
+    got = cli.build_schedule(args, derived_steps_per_epoch=7)
+    for step in [0, 1, 97, 98, 500, 588, 1000, 1959, 1960, 2500]:
+        assert float(got(jnp.asarray(step))) == float(ref(jnp.asarray(step)))
+    # And the default derives from the real shard size / CLI epochs.
+    args2 = cli.build_parser("t").parse_args(["5", "1"])
+    d = cli.build_schedule(args2, derived_steps_per_epoch=7)
+    peak = functools.partial(triangular_lr, base_lr=0.4, num_epochs=5,
+                             steps_per_epoch=7)
+    for step in [0, 3, 10, 34, 35]:
+        assert float(d(jnp.asarray(step))) == float(peak(jnp.asarray(step)))
+
+
+def test_graft_entry_hooks():
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as ge
+    fn, fargs = ge.entry()
+    logits = jax.jit(fn)(*fargs)
+    assert logits.shape == (8, 10)
+    ge.dryrun_multichip(2)
+    ge.dryrun_multichip(8)
