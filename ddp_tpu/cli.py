@@ -20,6 +20,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .data import EvalLoader, TrainLoader, cifar10
 from .models import get_model
@@ -72,6 +73,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Initialise weights from a torch state_dict "
                         "checkpoint of the reference (e.g. its "
                         "checkpoint.pt) instead of random init")
+    p.add_argument("--export_torch", default=None, metavar="PATH",
+                   help="After training, also write the model in the "
+                        "reference's torch state_dict checkpoint format "
+                        "(flat backbone.conv0.weight keys; VGG only)")
     p.add_argument("--schedule_epochs", default=None, type=int,
                    help="Pin the LR triangle's epoch span (the reference "
                         "hardcodes 20, multigpu.py:136; default: "
@@ -114,6 +119,34 @@ def build_schedule(args: argparse.Namespace, derived_steps_per_epoch: int):
         num_epochs=args.schedule_epochs or args.total_epochs,
         steps_per_epoch=(args.schedule_steps_per_epoch
                          or derived_steps_per_epoch))
+
+
+def _export_torch(model_name: str, path: str, trainer) -> None:
+    """Write the trained model as a reference-format torch state_dict
+    (the exact artifact ``torch.save(model.module.state_dict())`` produces,
+    multigpu.py:110-112) so reference tooling can consume it."""
+    if model_name != "vgg":
+        raise SystemExit("--export_torch currently supports the flagship "
+                         "vgg only")
+    try:
+        import torch
+    except ImportError as e:  # pragma: no cover
+        raise SystemExit(f"--export_torch needs torch to write the pickle: "
+                         f"{e}")
+    from .utils import torch_interop
+    sd = torch_interop.vgg_to_torch_state_dict(
+        jax.device_get(trainer.state.params),
+        jax.device_get(trainer.state.batch_stats))
+    out = {k: torch.from_numpy(np.ascontiguousarray(v))
+           for k, v in sd.items()}
+    # strict load_state_dict compatibility: torch BN carries a
+    # num_batches_tracked buffer the reference checkpoints too.
+    for k in list(out):
+        if k.endswith(".running_mean"):
+            out[k[:-len("running_mean")] + "num_batches_tracked"] = \
+                torch.zeros((), dtype=torch.long)
+    torch.save(out, path)
+    print(f"Torch state_dict exported to {path}")
 
 
 def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
@@ -173,6 +206,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     print(f"Total training time: {training_time:.2f} seconds")
     fp32_model_size = get_model_size(trainer.state.params, 32)
     print(f"fp32 model has size={fp32_model_size/MiB:.2f} MiB")
+    if args.export_torch and jax.process_index() == 0:
+        _export_torch(args.model, args.export_torch, trainer)
     eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
                              local_replicas=local_replicas)
     accuracy = evaluate(model, trainer.state.params, trainer.state.batch_stats,

@@ -52,6 +52,19 @@ def test_schedule_override_reproduces_reference_curve():
         assert float(d(jnp.asarray(step))) == float(peak(jnp.asarray(step)))
 
 
+def test_export_torch_roundtrip(tmp_path, monkeypatch):
+    """Trained weights exported as a reference-format state_dict load
+    strictly into the torch reference model."""
+    monkeypatch.chdir(tmp_path)
+    out = tmp_path / "exported.pt"
+    args = cli.build_parser("t").parse_args(
+        ["1", "1", "--batch_size", "8", "--synthetic", "--lr", "0.01",
+         "--num_devices", "8", "--export_torch", str(out)])
+    cli.run(args, num_devices=None)
+    tm = TorchVGG()
+    tm.load_state_dict(torch.load(str(out), weights_only=True), strict=True)
+
+
 def test_graft_entry_hooks():
     sys.path.insert(0, "/root/repo")
     import __graft_entry__ as ge
