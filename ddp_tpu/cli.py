@@ -48,6 +48,8 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="CIFAR-10 root (reference: data/cifar10)")
     p.add_argument("--synthetic", action="store_true",
                    help="Use a synthetic dataset (no CIFAR files needed)")
+    p.add_argument("--synthetic_size", default=2048, type=int,
+                   help="Training-set size for --synthetic (default 2048)")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (BASELINE.json config #4)")
     p.add_argument("--resume", action="store_true",
@@ -137,7 +139,7 @@ def _export_torch(model_name: str, path: str, trainer) -> None:
     sd = torch_interop.vgg_to_torch_state_dict(
         jax.device_get(trainer.state.params),
         jax.device_get(trainer.state.batch_stats))
-    out = {k: torch.from_numpy(np.ascontiguousarray(v))
+    out = {k: torch.from_numpy(np.array(v))  # copy: writable + contiguous
            for k, v in sd.items()}
     # strict load_state_dict compatibility: torch BN carries a
     # num_batches_tracked buffer the reference checkpoints too.
@@ -158,7 +160,9 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     n_replicas = mesh.devices.size
 
     if args.synthetic:
-        train_ds, test_ds = cifar10.synthetic()
+        train_ds, test_ds = cifar10.synthetic(
+            n_train=args.synthetic_size,
+            n_test=max(args.synthetic_size // 4, 64))
     else:
         train_ds, test_ds = cifar10.load(args.data_root)
 

@@ -24,7 +24,8 @@ def test_init_from_torch_checkpoint(tmp_path, capsys, monkeypatch):
 
     args = cli.build_parser("t").parse_args(
         ["1", "1", "--batch_size", "8", "--synthetic", "--lr", "0.01",
-         "--num_devices", "8", "--init_from_torch", str(ckpt)])
+         "--num_devices", "8", "--synthetic_size", "128",
+         "--init_from_torch", str(ckpt)])
     acc = cli.run(args, num_devices=None)
     assert 0.0 <= acc <= 100.0
     out = capsys.readouterr().out
@@ -59,7 +60,8 @@ def test_export_torch_roundtrip(tmp_path, monkeypatch):
     out = tmp_path / "exported.pt"
     args = cli.build_parser("t").parse_args(
         ["1", "1", "--batch_size", "8", "--synthetic", "--lr", "0.01",
-         "--num_devices", "8", "--export_torch", str(out)])
+         "--num_devices", "8", "--synthetic_size", "128",
+         "--export_torch", str(out)])
     cli.run(args, num_devices=None)
     tm = TorchVGG()
     tm.load_state_dict(torch.load(str(out), weights_only=True), strict=True)

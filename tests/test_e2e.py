@@ -20,7 +20,7 @@ def test_cli_end_to_end(tmp_path, capsys, monkeypatch):
     parser = cli.build_parser("test")
     args = parser.parse_args(
         ["2", "1", "--batch_size", "8", "--synthetic", "--lr", "0.05",
-         "--num_devices", "8"])
+         "--num_devices", "8", "--synthetic_size", "256"])
     acc = cli.run(args, num_devices=None)
     out = capsys.readouterr().out
     # Reference report lines (multigpu.py:102, 235, 238, 248).
