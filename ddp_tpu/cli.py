@@ -71,6 +71,11 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--device_augment", action="store_true",
                    help="Run RandomCrop+HFlip on the TPU inside the train "
                         "step instead of on the host (same distribution)")
+    p.add_argument("--resident", action="store_true",
+                   help="Keep the whole dataset resident in HBM and run "
+                        "each epoch as one jitted lax.scan: no per-step "
+                        "host->device batch traffic or dispatch (implies "
+                        "on-device augmentation)")
     p.add_argument("--init_from_torch", default=None, metavar="STATE_DICT",
                    help="Initialise weights from a torch state_dict "
                         "checkpoint of the reference (e.g. its "
@@ -176,13 +181,14 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
 
     # Each host materialises/augments only its own chips' rows (the per-host
     # shard DistributedSampler semantics, multigpu.py:153); single-host this
-    # is the full range.
-    ldc = jax.local_device_count()
-    local_replicas = range(jax.process_index() * ldc,
-                           jax.process_index() * ldc + ldc)
+    # is the full range.  Derived from the mesh itself so a --num_devices
+    # override (mesh smaller than the local device count) stays consistent.
+    local_replicas = [i for i, d in enumerate(mesh.devices.flat)
+                      if d.process_index == jax.process_index()]
+    device_augment = args.device_augment or args.resident
     train_loader = TrainLoader(train_ds, args.batch_size, n_replicas,
                                seed=args.seed, local_replicas=local_replicas,
-                               augment=not args.device_augment)
+                               augment=not device_augment)
     # Triangular schedule (reference singlegpu.py:142-149) with
     # steps_per_epoch derived from the real shard size and the triangle span
     # tied to the CLI epoch count — the two sanctioned fixes to the
@@ -196,7 +202,7 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       snapshot_path=args.snapshot_path,
                       compute_dtype=compute_dtype, seed=args.seed,
                       resume=args.resume, metrics=metrics,
-                      device_augment=args.device_augment)
+                      device_augment=device_augment, resident=args.resident)
 
     start = time.time()
     if args.profile_dir:
@@ -214,8 +220,15 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         _export_torch(args.model, args.export_torch, trainer)
     eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
                              local_replicas=local_replicas)
-    accuracy = evaluate(model, trainer.state.params, trainer.state.batch_stats,
-                        eval_loader, mesh)
+    if args.resident:
+        from .data.resident import ResidentData
+        from .train.evaluate import evaluate_resident
+        accuracy = evaluate_resident(
+            model, trainer.state.params, trainer.state.batch_stats,
+            ResidentData(test_ds, mesh), eval_loader, mesh)
+    else:
+        accuracy = evaluate(model, trainer.state.params,
+                            trainer.state.batch_stats, eval_loader, mesh)
     print(f"fp32 model has accuracy={accuracy:.2f}%")
     dist.shutdown()  # reference destroy_process_group (multigpu.py:250)
     return accuracy

@@ -1,9 +1,11 @@
 from .augment import random_crop_flip, to_float
 from .cifar10 import Dataset, load, synthetic
 from .loader import EvalLoader, TrainLoader
+from .resident import ResidentData
 from .sampler import DistributedShardSampler, ShuffleSampler
 
 __all__ = [
-    "Dataset", "DistributedShardSampler", "EvalLoader", "ShuffleSampler",
-    "TrainLoader", "load", "random_crop_flip", "synthetic", "to_float",
+    "Dataset", "DistributedShardSampler", "EvalLoader", "ResidentData",
+    "ShuffleSampler", "TrainLoader", "load", "random_crop_flip", "synthetic",
+    "to_float",
 ]

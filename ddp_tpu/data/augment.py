@@ -19,17 +19,31 @@ def random_crop_flip(batch: np.ndarray, rng: np.random.Generator
     """[N,32,32,3] uint8 -> augmented [N,32,32,3] uint8.
 
     Zero-padding and uniform offsets match torchvision RandomCrop defaults
-    (fill=0); flip probability 0.5.
+    (fill=0); flip probability 0.5.  All randomness is drawn here; the
+    memory movement dispatches to the native C++ kernel (data/native.py)
+    when available, else the vectorised numpy gather — both bit-identical
+    on the same draws (tests/test_native.py).
     """
     n = batch.shape[0]
-    padded = np.pad(batch, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
     ys = rng.integers(0, 2 * PAD + 1, n)
     xs = rng.integers(0, 2 * PAD + 1, n)
+    flip = rng.random(n) < 0.5
+    from . import native
+    out = native.crop_flip(batch, ys, xs, flip)
+    if out is not None:
+        return out
+    return _numpy_crop_flip(batch, ys, xs, flip)
+
+
+def _numpy_crop_flip(batch: np.ndarray, ys: np.ndarray, xs: np.ndarray,
+                     flip: np.ndarray) -> np.ndarray:
+    """Pure-numpy reference implementation (one batched gather)."""
+    n = batch.shape[0]
+    padded = np.pad(batch, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
     row = np.arange(SIZE)
     out = padded[np.arange(n)[:, None, None],
                  (ys[:, None] + row)[:, :, None],
                  (xs[:, None] + row)[:, None, :], :]
-    flip = rng.random(n) < 0.5
     out[flip] = out[flip, :, ::-1]
     return out
 

@@ -16,22 +16,47 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 PAD = 4
 SIZE = 32
 
 
 def random_crop_flip(rng: jax.Array, imgs: jax.Array) -> jax.Array:
-    """[N,32,32,3] (any dtype) -> same shape/dtype, cropped+flipped."""
-    n = imgs.shape[0]
+    """[N,32,32,3] (any dtype) -> same shape/dtype, cropped+flipped.
+
+    Exactly :func:`gather_crop_flip` with the identity index row — the
+    delegation makes the per-step and resident paths bit-identical *by
+    construction* (same RNG draws, same gather), not merely by test.
+    """
+    return gather_crop_flip(rng, imgs, jnp.arange(imgs.shape[0]))
+
+
+def gather_crop_flip(rng: jax.Array, table: jax.Array,
+                     idx_row: jax.Array) -> jax.Array:
+    """Fused dataset-gather + RandomCrop(32, pad 4) + HFlip for the
+    device-resident path (train/epoch.py).
+
+    ``table`` is the whole resident dataset ``[M,32,32,3]``; the batch
+    ``table[idx_row]``, its zero-padding, the crop, and the flip collapse
+    into ONE gather with clamped source indices plus a validity mask (the
+    mask multiply zeroes what the reference's zero-padding would have
+    supplied).  No padded or pre-gathered intermediate ever materialises —
+    a single batched gather is ~5x faster on TPU than the
+    vmap-of-``dynamic_slice`` formulation (~10 ms per 512 images, enough
+    to dominate the resident train step).
+    """
+    n = idx_row.shape[0]
     k_off, k_flip = jax.random.split(rng)
     ys, xs = jax.random.randint(k_off, (2, n), 0, 2 * PAD + 1)
     flip = jax.random.bernoulli(k_flip, 0.5, (n,))
-    padded = jnp.pad(imgs, ((0, 0), (PAD, PAD), (PAD, PAD), (0, 0)))
-
-    def crop_one(img, y, x):
-        return lax.dynamic_slice(img, (y, x, 0), (SIZE, SIZE, img.shape[-1]))
-
-    out = jax.vmap(crop_one)(padded, ys, xs)
-    return jnp.where(flip[:, None, None, None], out[:, :, ::-1, :], out)
+    row = jnp.arange(SIZE)
+    y_src = ys[:, None] + row[None, :] - PAD                 # [N, 32]
+    x_cols = jnp.where(flip[:, None], SIZE - 1 - row[None, :],
+                       row[None, :])
+    x_src = xs[:, None] + x_cols - PAD                       # [N, 32]
+    valid = (((y_src >= 0) & (y_src < SIZE))[:, :, None]
+             & ((x_src >= 0) & (x_src < SIZE))[:, None, :])  # [N, 32, 32]
+    yc = jnp.clip(y_src, 0, SIZE - 1)
+    xc = jnp.clip(x_src, 0, SIZE - 1)
+    out = table[idx_row[:, None, None], yc[:, :, None], xc[:, None, :], :]
+    return out * valid[..., None].astype(out.dtype)

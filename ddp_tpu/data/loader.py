@@ -96,6 +96,29 @@ class TrainLoader:
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         return (self.materialize(k) for k in range(self.steps_per_epoch))
 
+    def epoch_index_matrix(self):
+        """The current epoch's batches as sample indices, for the
+        device-resident path (data/resident.py + train/epoch.py).
+
+        Returns ``(full, tail)``: ``full`` is int32 ``[steps_full,
+        R_local * b]`` where row k holds exactly the indices
+        ``materialize(k)`` would gather (replica row-blocks concatenated in
+        the same order), and ``tail`` is the final ragged global batch's
+        indices (``[R_local * b_tail]``) or ``None`` when the shard size
+        divides the batch — the same true-shape ragged-batch semantics as
+        the streaming path (singlegpu.py:179, drop_last=False).
+        """
+        shards = self._epoch_shards()
+        b = self.per_replica_batch
+        n_full = len(shards[0]) // b
+        full = np.concatenate(
+            [sh[:n_full * b].reshape(n_full, b) for sh in shards],
+            axis=1).astype(np.int32)
+        tails = [sh[n_full * b:] for sh in shards]
+        tail = (np.concatenate(tails).astype(np.int32)
+                if len(tails[0]) else None)
+        return full, tail
+
 
 class EvalLoader:
     """Sequential test-set batches, padded+masked to mesh divisibility.
@@ -116,6 +139,29 @@ class EvalLoader:
 
     def __len__(self) -> int:
         return -(-len(self.dataset) // self.global_batch)
+
+    def epoch_index_matrix(self):
+        """Test-set indices as ``(idx, mask)`` of shape ``[steps,
+        global_batch]`` for the device-resident eval scan
+        (train/epoch.py:make_eval_epoch).  Sequential order
+        (shuffle=False, multigpu.py:244), padded with masked index-0 rows to
+        keep shapes static; multi-host keeps only this process's replicas'
+        column blocks."""
+        n = len(self.dataset)
+        steps = -(-n // self.global_batch)
+        total = steps * self.global_batch
+        idx = np.zeros(total, np.int32)
+        idx[:n] = np.arange(n, dtype=np.int32)
+        mask = np.zeros(total, np.float32)
+        mask[:n] = 1.0
+        idx = idx.reshape(steps, self.global_batch)
+        mask = mask.reshape(steps, self.global_batch)
+        if len(self.local_replicas) != self.num_replicas:
+            per = self.global_batch // self.num_replicas
+            cols = np.concatenate([np.arange(r * per, (r + 1) * per)
+                                   for r in self.local_replicas])
+            idx, mask = idx[:, cols], mask[:, cols]
+        return idx, mask
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         n = len(self.dataset)

@@ -37,3 +37,30 @@ def evaluate(model, params, batch_stats, loader, mesh, *,
         correct += float(c)
         total += float(t)
     return correct / max(total, 1.0) * 100.0
+
+
+_epoch_cache: dict = {}
+
+
+def evaluate_resident(model, params, batch_stats, resident, loader, mesh, *,
+                      compute_dtype=None) -> float:
+    """Accuracy (%) over a device-resident test set, as ONE jitted scan.
+
+    Same result as :func:`evaluate` (same masked ``psum`` counters —
+    tests/test_resident.py pins the equality) without the per-batch
+    host->device transfers and dispatches; ``resident`` is a
+    :class:`~ddp_tpu.data.resident.ResidentData` of ``loader.dataset``.
+    """
+    from .epoch import make_eval_epoch, put_index_matrix
+
+    key = (model, mesh, compute_dtype)
+    eval_epoch = _epoch_cache.get(key)
+    if eval_epoch is None:
+        eval_epoch = _epoch_cache[key] = make_eval_epoch(
+            model, mesh, compute_dtype=compute_dtype)
+    idx, mask = loader.epoch_index_matrix()
+    correct, total = eval_epoch(params, batch_stats, resident.images,
+                                resident.labels,
+                                put_index_matrix(idx, mesh),
+                                put_index_matrix(mask, mesh))
+    return float(correct) / max(float(total), 1.0) * 100.0

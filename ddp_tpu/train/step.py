@@ -65,36 +65,37 @@ def init_train_state(params, batch_stats) -> TrainState:
                       jnp.zeros((), jnp.int32))
 
 
-def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
+def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
-                    mesh: Mesh, compute_dtype=None,
-                    device_augment: bool = False):
-    """Build the jitted SPMD train step for ``model`` over ``mesh``.
+                    compute_dtype=None):
+    """The per-batch training math, as a pure per-shard function.
 
-    Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
-    is ``{"image": u8|f32[B,H,W,C], "label": i32[B]}`` with B divisible by
-    the mesh size, globally sharded on ``data``.  ``rng`` feeds dropout
-    (DeepNN, singlegpu.py:36) and, with ``device_augment=True``, the
-    on-device RandomCrop+HFlip (data/device_augment.py) — in that mode the
-    loader must be built with ``augment=False``.
+    ``core(state, get_batch, rng) -> (state, loss)`` — everything the
+    reference's ``Trainer._run_batch`` does (multigpu.py:92-98), written to
+    run *inside* a ``shard_map`` over the ``data`` axis.  ``get_batch(rng)
+    -> (images, labels)`` lets each caller materialise the batch its own
+    way (per-step: the incoming sharded batch, optionally device-augmented;
+    resident epoch: a fused gather+augment from the HBM-resident dataset)
+    while the training math stays shared verbatim — the two execution
+    strategies cannot drift numerically (pinned by tests/test_resident.py).
     """
 
-    def _shard_body(state: TrainState, batch, rng):
+    def core(state: TrainState, get_batch, rng):
         # Per-step, per-shard RNG so dropout masks differ across steps and
         # across replicas' data shards; the caller passes one constant key.
         rng = jax.random.fold_in(rng, state.step)
         rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-        images = batch["image"]
-        if device_augment:
-            from ..data.device_augment import random_crop_flip
-            images = random_crop_flip(jax.random.fold_in(rng, 1), images)
+        # fold_in(rng, 1) is the augmentation stream: every batch provider
+        # draws from the same key, so per-step and resident paths augment
+        # bit-identically.
+        images, labels = get_batch(jax.random.fold_in(rng, 1))
 
         def loss_fn(params):
             logits, new_stats = model.apply(
                 params, state.batch_stats,
                 _as_input(images, compute_dtype), train=True,
                 rng=rng, compute_dtype=compute_dtype)
-            ce_sum, count = cross_entropy_sum_count(logits, batch["label"])
+            ce_sum, count = cross_entropy_sum_count(logits, labels)
             # Global mean: psum(sum)/psum(count).  Equal per-shard counts
             # (DistributedSampler padding guarantee, multigpu.py:153) make
             # this identical to DDP's mean-of-rank-means.
@@ -117,6 +118,35 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
         params, opt_state = sgd_lib.apply_updates(
             state.params, grads, state.opt_state, lr_t, sgd_config)
         return TrainState(params, new_stats, opt_state, state.step + 1), loss
+
+    return core
+
+
+def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
+                    lr_schedule: Callable[[jax.Array], jax.Array],
+                    mesh: Mesh, compute_dtype=None,
+                    device_augment: bool = False):
+    """Build the jitted SPMD train step for ``model`` over ``mesh``.
+
+    Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
+    is ``{"image": u8|f32[B,H,W,C], "label": i32[B]}`` with B divisible by
+    the mesh size, globally sharded on ``data``.  ``rng`` feeds dropout
+    (DeepNN, singlegpu.py:36) and, with ``device_augment=True``, the
+    on-device RandomCrop+HFlip (data/device_augment.py) — in that mode the
+    loader must be built with ``augment=False``.
+    """
+    core = make_batch_core(model, sgd_config, lr_schedule,
+                           compute_dtype=compute_dtype)
+
+    def _shard_body(state: TrainState, batch, rng):
+        def get_batch(aug_rng):
+            images = batch["image"]
+            if device_augment:
+                from ..data.device_augment import random_crop_flip
+                images = random_crop_flip(aug_rng, images)
+            return images, batch["label"]
+
+        return core(state, get_batch, rng)
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,

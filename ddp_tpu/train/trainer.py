@@ -44,7 +44,8 @@ class Trainer:
                  compute_dtype=None, seed: int = 0,
                  resume: bool = False,
                  metrics: Optional[MetricsLogger] = None,
-                 device_augment: bool = False):
+                 device_augment: bool = False,
+                 resident: bool = False):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -68,17 +69,31 @@ class Trainer:
                 jnp.asarray(ckpt.step, jnp.int32))
             self.start_epoch = ckpt.epoch + 1
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
-        self.train_step = make_train_step(
-            model, sgd_config, lr_schedule, mesh,
-            compute_dtype=compute_dtype, device_augment=device_augment)
+        self.resident = None
+        if resident:
+            # Device-resident path: dataset uploaded once, whole epoch as a
+            # single jitted lax.scan (train/epoch.py) — zero per-step host
+            # involvement.  Augmentation necessarily runs on device.
+            if getattr(train_loader, "augment", False):
+                raise ValueError(
+                    "resident=True never materialises host batches, so the "
+                    "loader's host-side augmentation would be silently "
+                    "skipped; build the TrainLoader with augment=False and "
+                    "pass device_augment=True instead")
+            from ..data.resident import ResidentData
+            from .epoch import make_train_epoch
+            self.resident = ResidentData(train_loader.dataset, mesh)
+            self.train_epoch = make_train_epoch(
+                model, sgd_config, lr_schedule, mesh,
+                compute_dtype=compute_dtype, device_augment=device_augment)
+        else:
+            self.train_step = make_train_step(
+                model, sgd_config, lr_schedule, mesh,
+                compute_dtype=compute_dtype, device_augment=device_augment)
 
-    def _run_epoch(self, epoch: int) -> None:
-        b_sz = self.train_loader.per_replica_batch
-        # Reference epoch header (multigpu.py:102) — without materialising
-        # and discarding a probe batch to learn b_sz (multigpu.py:101).
-        print(f"[GPU{self.gpu_id}] Epoch {epoch} | Batchsize: {b_sz} | "
-              f"Steps: {len(self.train_loader)}")
-        self.train_loader.set_epoch(epoch)
+    def _epoch_losses_streaming(self):
+        """Per-step dispatch over host-fed batches (the reference's loop,
+        multigpu.py:104-107)."""
         epoch_losses = []
         # Background thread augments + device_puts ahead of the loop (the
         # pin_memory/worker analogue, singlegpu.py:177); combined with JAX
@@ -88,11 +103,42 @@ class Trainer:
             self.state, loss = self.train_step(
                 self.state, device_batch, self.rng)
             epoch_losses.append(loss)
-        start_step = int(self.state.step) - len(epoch_losses)
+        return jnp.stack(epoch_losses) if epoch_losses else None
+
+    def _epoch_losses_resident(self):
+        """One (or two, with a ragged tail) jitted scan calls per epoch."""
+        from .epoch import put_index_matrix
+        full, tail = self.train_loader.epoch_index_matrix()
+        parts = []
+        if full.shape[0]:
+            idx = put_index_matrix(full, self.mesh)
+            self.state, losses = self.train_epoch(
+                self.state, self.resident.images, self.resident.labels,
+                idx, self.rng)
+            parts.append(losses)
+        if tail is not None:
+            idx = put_index_matrix(tail[None, :], self.mesh)
+            self.state, tail_loss = self.train_epoch(
+                self.state, self.resident.images, self.resident.labels,
+                idx, self.rng)
+            parts.append(tail_loss)
+        return jnp.concatenate(parts) if parts else None
+
+    def _run_epoch(self, epoch: int) -> None:
+        b_sz = self.train_loader.per_replica_batch
+        # Reference epoch header (multigpu.py:102) — without materialising
+        # and discarding a probe batch to learn b_sz (multigpu.py:101).
+        print(f"[GPU{self.gpu_id}] Epoch {epoch} | Batchsize: {b_sz} | "
+              f"Steps: {len(self.train_loader)}")
+        self.train_loader.set_epoch(epoch)
+        stacked = (self._epoch_losses_resident() if self.resident is not None
+                   else self._epoch_losses_streaming())
+        n_losses = int(stacked.shape[0]) if stacked is not None else 0
+        start_step = int(self.state.step) - n_losses
         # One stacked D2H transfer for the whole epoch's losses — per-scalar
         # reads pay a link round trip each on remote-device setups.
-        losses = (np.asarray(jax.device_get(jnp.stack(epoch_losses))).tolist()
-                  if epoch_losses else [])
+        losses = (np.asarray(jax.device_get(stacked)).tolist()
+                  if stacked is not None else [])
         self.loss_history.extend(losses)
         if self.metrics is not None and losses:
             # One vectorised device eval of the schedule per epoch.

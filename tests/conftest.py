@@ -21,5 +21,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite is dominated by XLA compiles of
+# the VGG train/epoch programs (~30s each on CPU); caching their serialized
+# executables roughly halves re-run time.  Safe on CPU without the AOT
+# `xla_caches` extras (those emit machine-feature-mismatch warnings here).
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 # Make the repo root importable regardless of pytest rootdir configuration.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

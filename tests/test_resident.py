@@ -1,0 +1,144 @@
+"""Device-resident scan-per-epoch path vs the streaming per-step path.
+
+The two execution strategies share the per-batch math
+(train/step.py:make_batch_core), so on identical weights and data order
+they must agree — the same golden-reference discipline the reference's two
+scripts embody (singlegpu.py as the numerics fixture for multigpu.py,
+SURVEY.md §4).
+
+Tolerances: the first few steps agree bitwise; beyond that the two XLA
+programs' fusion-order ULP differences amplify through the chaotic training
+dynamics (measured: bit-equal for 3 steps at lr 0.1, then divergence), so
+parity is asserted over a SHORT horizon at low lr.  Meshes are kept at 2
+devices: compiling the scanned VGG epoch for an 8-device CPU mesh takes
+tens of minutes (CPU-backend artifact; the real-TPU compile is ~15 s).
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddp_tpu.data import EvalLoader, ResidentData, TrainLoader, synthetic
+from ddp_tpu.models import get_model
+from ddp_tpu.optim import SGDConfig, triangular_lr
+from ddp_tpu.parallel import make_mesh
+from ddp_tpu.train import Trainer, evaluate
+from ddp_tpu.train.evaluate import evaluate_resident
+
+
+def _train(resident, *, n_train, batch, replicas, epochs=1,
+           device_augment=False, model_name="vgg", seed=3, lr=0.02):
+    train_ds, _ = synthetic(n_train=n_train, n_test=16)
+    mesh = make_mesh(replicas)
+    model = get_model(model_name)
+    params, stats = model.init(jax.random.key(seed))
+    loader = TrainLoader(train_ds, batch, replicas, seed=seed,
+                         augment=False)
+    sched = functools.partial(triangular_lr, base_lr=lr, num_epochs=epochs,
+                              steps_per_epoch=len(loader))
+    tr = Trainer(model, loader, params, stats, mesh=mesh, lr_schedule=sched,
+                 sgd_config=SGDConfig(lr=lr), save_every=10**9,
+                 snapshot_path=None, seed=seed,
+                 device_augment=device_augment, resident=resident)
+    tr.train(epochs)
+    return tr
+
+
+def _assert_same_training(a, b):
+    # The first steps must agree to float noise — any semantic difference
+    # (wrong indices, different augmentation RNG, BN over the wrong axis)
+    # shows up here as a wholesale change, not a 1e-7.
+    np.testing.assert_allclose(a.loss_history[:2], b.loss_history[:2],
+                               rtol=0, atol=1e-6)
+    # Later steps: fusion-order ULP drift between the two XLA programs
+    # amplifies through the training dynamics (measured ~1e-5 by step 4
+    # at lr 0.02); the loose bound still rules out any real divergence.
+    np.testing.assert_allclose(a.loss_history, b.loss_history,
+                               rtol=2e-3, atol=2e-3)
+    fa = jax.tree_util.tree_leaves(a.state.params)
+    fb = jax.tree_util.tree_leaves(b.state.params)
+    for la, lb in zip(fa, fb):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                   rtol=2e-3, atol=2e-3)
+    assert int(a.state.step) == int(b.state.step)
+
+
+def test_resident_matches_streaming():
+    """Scan-epoch == per-step loop on a 2-way mesh (augment off)."""
+    kw = dict(n_train=64, batch=8, replicas=2)  # 4 steps
+    _assert_same_training(_train(False, **kw), _train(True, **kw))
+
+
+def test_resident_matches_streaming_device_augment():
+    """Both paths fold the same augmentation RNG per step: the per-step
+    random_crop_flip and the resident fused gather_crop_flip must agree."""
+    kw = dict(n_train=64, batch=8, replicas=2, device_augment=True)
+    _assert_same_training(_train(False, **kw), _train(True, **kw))
+
+
+def test_resident_ragged_tail():
+    """Shard size not divisible by batch: the tail batch runs at its true
+    shape in both paths (singlegpu.py:179 drop_last=False semantics)."""
+    # 2 replicas x 36/2=18 per shard, batch 8 -> 2 full steps + tail of 2.
+    kw = dict(n_train=36, batch=8, replicas=2)
+    a, b = _train(False, **kw), _train(True, **kw)
+    assert len(a.loss_history) == 3  # 2 full + 1 tail
+    _assert_same_training(a, b)
+
+
+def test_resident_single_replica_ragged():
+    """Mesh of 1 with the plain shuffle sampler (singlegpu.py path)."""
+    kw = dict(n_train=40, batch=16, replicas=1)
+    a, b = _train(False, **kw), _train(True, **kw)
+    assert len(a.loss_history) == 3  # 2 full + tail of 8
+    _assert_same_training(a, b)
+
+
+def test_epoch_index_matrix_matches_materialize():
+    """Row k of the index matrix gathers exactly materialize(k)'s rows —
+    host-level check, full 8-way sharding, both sampler kinds."""
+    # 468: ragged under both samplers (8-way: 59/shard -> 7x8 + tail 3;
+    # 1-way: 58x8 + tail 4).
+    train_ds, _ = synthetic(n_train=468, n_test=16)
+    for replicas in (8, 1):
+        loader = TrainLoader(train_ds, 8, replicas, seed=5, augment=False)
+        loader.set_epoch(1)
+        full, tail = loader.epoch_index_matrix()
+        for k in range(full.shape[0]):
+            np.testing.assert_array_equal(train_ds.images[full[k]],
+                                          loader.materialize(k)["image"])
+        last = loader.materialize(full.shape[0])
+        assert tail is not None
+        np.testing.assert_array_equal(train_ds.images[tail], last["image"])
+        np.testing.assert_array_equal(train_ds.labels[tail], last["label"])
+
+
+def test_evaluate_resident_matches_streaming():
+    """One-scan resident eval == batched streaming eval, ragged test set."""
+    _, test_ds = synthetic(n_train=16, n_test=84)
+    mesh = make_mesh(2)
+    model = get_model("vgg")
+    params, stats = model.init(jax.random.key(0))
+    loader = EvalLoader(test_ds, 16, 2)  # 84 = 2 full global batches + 20
+    acc_stream = evaluate(model, params, stats, loader, mesh,
+                          progress=False)
+    acc_res = evaluate_resident(model, params, stats,
+                                ResidentData(test_ds, mesh), loader, mesh)
+    assert abs(acc_stream - acc_res) < 1e-4, (acc_stream, acc_res)
+
+
+def test_resident_cli_end_to_end(tmp_path, capsys, monkeypatch):
+    """The --resident flag through the real CLI: same report surface."""
+    from ddp_tpu import cli
+    monkeypatch.chdir(tmp_path)
+    parser = cli.build_parser("test")
+    args = parser.parse_args(
+        ["1", "1", "--batch_size", "8", "--synthetic", "--resident",
+         "--lr", "0.05", "--num_devices", "2", "--synthetic_size", "64"])
+    acc = cli.run(args, num_devices=None)
+    out = capsys.readouterr().out
+    assert "[GPU0] Epoch 0 | Batchsize: 8 | Steps:" in out
+    assert "fp32 model has accuracy=" in out
+    assert (tmp_path / "checkpoint.pt").exists()
+    assert 0.0 <= acc <= 100.0
