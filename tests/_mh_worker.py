@@ -3,7 +3,12 @@ devices each) driving the REAL framework path — ``jax.distributed``
 rendezvous, per-host ``TrainLoader`` slice, ``make_array_from_process_local_
 data`` batch assembly, shard_map train step, process-0 checkpoint write.
 
-Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path>
+Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path> [mode]
+
+``mode`` is ``streaming`` (default; per-step host-fed batches) or
+``resident`` (HBM-resident dataset + scan-per-epoch: exercises
+``make_array_from_process_local_data`` for the dataset upload and
+``put_index_matrix``'s local-column assembly across real processes).
 """
 import os
 import sys
@@ -18,6 +23,7 @@ jax.config.update("jax_platforms", "cpu")
 
 def main() -> None:
     pid, coordinator, ckpt_path = (int(sys.argv[1]), sys.argv[2], sys.argv[3])
+    resident = len(sys.argv) > 4 and sys.argv[4] == "resident"
     from ddp_tpu.parallel import dist
     dist.initialize(coordinator=coordinator, num_processes=2, process_id=pid)
     assert jax.process_count() == 2 and jax.device_count() == 8
@@ -41,7 +47,8 @@ def main() -> None:
                               steps_per_epoch=len(loader))
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
-                      save_every=1, snapshot_path=ckpt_path)
+                      save_every=1, snapshot_path=ckpt_path,
+                      resident=resident)
     trainer.train(2)  # process 0 writes the checkpoint (rank-0 gate)
     dist.shutdown()
 

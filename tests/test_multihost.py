@@ -33,15 +33,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_matches_single_process(tmp_path):
+def _run_and_compare(tmp_path, mode: str, *, rtol=1e-6, atol=1e-7) -> None:
     ckpt = str(tmp_path / "mh.pt")
     coord = f"localhost:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(pid), coord, ckpt],
+        [sys.executable, _WORKER, str(pid), coord, ckpt, mode],
         cwd=_REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT) for pid in (0, 1)]
     outs = [p.communicate(timeout=600)[0].decode() for p in procs]
@@ -60,7 +59,8 @@ def test_two_process_matches_single_process(tmp_path):
                               steps_per_epoch=len(loader))
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
-                      save_every=100, snapshot_path=str(tmp_path / "sp.pt"))
+                      save_every=100, snapshot_path=str(tmp_path / "sp.pt"),
+                      resident=(mode == "resident"))
     trainer.train(2)
 
     got = load_checkpoint(ckpt)
@@ -70,5 +70,26 @@ def test_two_process_matches_single_process(tmp_path):
                                     got.params)):
         assert pw == pg
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
-                                   rtol=1e-6, atol=1e-7, err_msg=str(pw))
+                                   rtol=rtol, atol=atol, err_msg=str(pw))
     assert got.step == int(trainer.state.step)
+
+
+@pytest.mark.slow
+def test_two_process_matches_single_process(tmp_path):
+    _run_and_compare(tmp_path, "streaming")
+
+
+@pytest.mark.slow
+def test_two_process_resident_matches_single_process(tmp_path):
+    """The resident path's two real multi-process branches — dataset upload
+    via make_array_from_process_local_data (data/resident.py) and
+    put_index_matrix's per-process column assembly (train/epoch.py) —
+    against a single-process resident run of identical configuration.
+
+    Tolerance: the 2-process and 1-process scan programs are different XLA
+    compilations whose fusion/reduction order differs at the ULP level;
+    measured divergence after 8 steps at lr 0.1 is ~5e-6 (identical against
+    both the resident and streaming single-process ground truths, ruling
+    out any indexing/assembly error — a wrong column mapping would show up
+    as O(1) differences)."""
+    _run_and_compare(tmp_path, "resident", rtol=1e-4, atol=1e-5)
