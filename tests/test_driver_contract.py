@@ -1,0 +1,54 @@
+"""The two external contracts this repo must keep: ``bench.py`` printing one
+JSON line, and ``__graft_entry__``'s hooks compiling/executing.
+
+These are exercised by the round driver on real hardware; breaking either is
+silent until the end of a round, so they get CI coverage on the CPU mesh.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_prints_one_json_line():
+    """bench.py's stdout contract: exactly one line, the four driver keys.
+
+    deepnn at a tiny batch keeps the CPU-mesh compile in seconds (the
+    driver runs the real VGG/512 config on the TPU chip).
+    """
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="cpu", PYTHONPATH=_REPO)
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--model", "deepnn", "--batch_size", "8",
+         "--steps", "2", "--warmup", "1", "--repeats", "1"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+    assert rec["value"] > 0 and rec["unit"] == "samples/sec/chip"
+
+
+def test_graft_entry_compiles():
+    """entry() must be jittable single-chip with its example args."""
+    sys.path.insert(0, _REPO)
+    import __graft_entry__ as graft
+    fn, args = graft.entry()
+    logits = jax.jit(fn)(*args)
+    assert logits.shape == (args[-1].shape[0], 10)
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip():
+    """dryrun_multichip(8) must jit + execute the full DP train step over
+    the 8-device mesh (the conftest CPU fake of a TPU slice)."""
+    sys.path.insert(0, _REPO)
+    import __graft_entry__ as graft
+    graft.dryrun_multichip(8)
