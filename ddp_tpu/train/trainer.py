@@ -60,7 +60,7 @@ class Trainer:
         self.loss_history: List[float] = []
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
-        if resume and os.path.exists(snapshot_path):
+        if resume and snapshot_path and os.path.exists(snapshot_path):
             ckpt = load_checkpoint(snapshot_path)
             self.state = TrainState(
                 jax.tree_util.tree_map(jnp.asarray, ckpt.params),
