@@ -7,20 +7,29 @@ download=True)`` (singlegpu.py:161-171).  We read the same on-disk layout
 hosts, and the unpickled arrays feed the vectorised augmentation pipeline
 (``augment.py``) without a per-sample Python transform stage.
 
-No network download is attempted (TPU pods are usually egress-less); if the
-data is absent the error says where to put it.  ``synthetic()`` provides a
-deterministic stand-in with the same shapes/dtypes for tests and benches.
+Like the reference (``download=True``), :func:`load` fetches the official
+tarball when the data is absent — but failure is graceful: TPU pods are
+usually egress-less, so a network error degrades to a FileNotFoundError
+that says where to put the files.  ``synthetic()`` provides a deterministic
+stand-in with the same shapes/dtypes for tests and benches.
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import pickle
+import tarfile
+import tempfile
 from typing import NamedTuple, Tuple
 
 import numpy as np
 
 DEFAULT_ROOT = "data/cifar10"
 _BATCH_DIR = "cifar-10-batches-py"
+# The official source torchvision uses (singlegpu.py:161-171 downloads
+# through torchvision.datasets.CIFAR10, which fetches exactly this tarball).
+_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+_MD5 = "c58f30108f718f92721af3b95e74349a"
 NUM_CLASSES = 10
 
 
@@ -40,14 +49,57 @@ def _load_batch(path: str) -> Tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(imgs), labels
 
 
-def load(root: str = DEFAULT_ROOT) -> Tuple[Dataset, Dataset]:
-    """(train 50k, test 10k) from the standard pickle layout."""
+def _download(root: str, url: str = _URL, md5: str = _MD5) -> bool:
+    """Fetch + verify + extract the official tarball; False on any failure.
+
+    Process-race-safe the same way the reference's torchvision download is
+    not required to be: the extraction happens in a temp dir and is moved
+    into place atomically, so concurrent hosts can all call this.
+    """
+    import urllib.request
+    try:
+        os.makedirs(root, exist_ok=True)
+        with tempfile.TemporaryDirectory(dir=root) as tmp:
+            tar_path = os.path.join(tmp, "cifar10.tar.gz")
+            with urllib.request.urlopen(url, timeout=60) as r, \
+                    open(tar_path, "wb") as f:
+                digest = hashlib.md5()
+                while chunk := r.read(1 << 20):
+                    digest.update(chunk)
+                    f.write(chunk)
+            if md5 and digest.hexdigest() != md5:
+                return False
+            with tarfile.open(tar_path) as tf:
+                tf.extractall(tmp, filter="data")
+            src = os.path.join(tmp, _BATCH_DIR)
+            if not os.path.isdir(src):
+                return False
+            try:
+                os.rename(src, os.path.join(root, _BATCH_DIR))
+            except OSError:
+                pass  # another process won the race — fine, data exists
+        return os.path.isdir(os.path.join(root, _BATCH_DIR))
+    except Exception:
+        return False
+
+
+def load(root: str = DEFAULT_ROOT,
+         download: bool = True) -> Tuple[Dataset, Dataset]:
+    """(train 50k, test 10k) from the standard pickle layout.
+
+    ``download=True`` mirrors the reference (singlegpu.py:165): fetch the
+    official tarball when absent — degrading to the explanatory error below
+    when the host has no egress.
+    """
     base = os.path.join(root, _BATCH_DIR)
+    if not os.path.isdir(base) and download:
+        _download(root)
     if not os.path.isdir(base):
         raise FileNotFoundError(
-            f"CIFAR-10 not found under {base!r}. Place the extracted "
-            "'cifar-10-batches-py' directory there (the reference's "
-            "torchvision download layout), or run with --synthetic.")
+            f"CIFAR-10 not found under {base!r} and auto-download failed "
+            "(egress-less host?). Place the extracted 'cifar-10-batches-py' "
+            "directory there (the reference's torchvision download layout), "
+            "or run with --synthetic.")
     train_parts = [_load_batch(os.path.join(base, f"data_batch_{i}"))
                    for i in range(1, 6)]
     train = Dataset(np.concatenate([p[0] for p in train_parts]),

@@ -130,3 +130,43 @@ def test_to_float_matches_totensor_scaling():
     # Exact torchvision ToTensor scaling: x / 255.
     t = torch.from_numpy(batch.transpose(0, 3, 1, 2)).float() / 255.0
     np.testing.assert_allclose(f[0, :, :, 0], t[0, 0].numpy())
+
+
+def test_load_download_and_extract(tmp_path):
+    """load(download=True) fetches + verifies + extracts the torchvision
+    tarball layout (reference singlegpu.py:165) — exercised via a local
+    file:// URL standing in for the official source."""
+    import hashlib
+    import pickle
+    import tarfile
+
+    from ddp_tpu.data import cifar10
+
+    # Build a miniature tarball in the official layout (2 images/batch).
+    src = tmp_path / "build" / "cifar-10-batches-py"
+    src.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+        data = rng.integers(0, 256, (2, 3 * 32 * 32), dtype=np.int64)
+        with open(src / name, "wb") as f:
+            pickle.dump({b"data": data.astype(np.uint8),
+                         b"labels": [0, 1]}, f)
+    tar = tmp_path / "cifar-10-python.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(src, arcname="cifar-10-batches-py")
+    md5 = hashlib.md5(tar.read_bytes()).hexdigest()
+
+    root = tmp_path / "root"
+    assert cifar10._download(str(root), url=tar.as_uri(), md5=md5)
+    # Wrong checksum must refuse the payload.
+    assert not cifar10._download(str(tmp_path / "bad"), url=tar.as_uri(),
+                                 md5="0" * 32)
+
+    train, test = cifar10.load(str(root), download=False)
+    assert train.images.shape == (10, 32, 32, 3)
+    assert test.images.shape == (2, 32, 32, 3)
+    assert train.images.dtype == np.uint8
+
+    # Absent data + failed download -> the explanatory error.
+    with pytest.raises(FileNotFoundError, match="synthetic"):
+        cifar10.load(str(tmp_path / "nowhere"), download=False)
