@@ -8,6 +8,9 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# CLI tests must not re-point the compilation cache at the user-level dir
+# (cli._enable_compilation_cache) — the suite uses tests/.jax_cache below.
+os.environ["DDP_TPU_COMPILATION_CACHE"] = "0"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
