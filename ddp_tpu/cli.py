@@ -76,6 +76,11 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "each epoch as one jitted lax.scan: no per-step "
                         "host->device batch traffic or dispatch (implies "
                         "on-device augmentation)")
+    p.add_argument("--shard_update", action="store_true",
+                   help="ZeRO-1-style weight-update sharding: "
+                        "reduce-scatter grads, update a 1/R momentum+param "
+                        "slice per chip, all-gather params (same math as "
+                        "plain DP, 1/R optimizer memory)")
     p.add_argument("--init_from_torch", default=None, metavar="STATE_DICT",
                    help="Initialise weights from a torch state_dict "
                         "checkpoint of the reference (e.g. its "
@@ -227,7 +232,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       snapshot_path=args.snapshot_path,
                       compute_dtype=compute_dtype, seed=args.seed,
                       resume=args.resume, metrics=metrics,
-                      device_augment=device_augment, resident=args.resident)
+                      device_augment=device_augment, resident=args.resident,
+                      shard_update=args.shard_update)
 
     start = time.time()
     if args.profile_dir:

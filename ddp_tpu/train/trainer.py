@@ -45,7 +45,8 @@ class Trainer:
                  resume: bool = False,
                  metrics: Optional[MetricsLogger] = None,
                  device_augment: bool = False,
-                 resident: bool = False):
+                 resident: bool = False,
+                 shard_update: bool = False):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -69,6 +70,21 @@ class Trainer:
                 jnp.asarray(ckpt.step, jnp.int32))
             self.start_epoch = ckpt.epoch + 1
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
+        self.shard_update = shard_update
+        if shard_update:
+            # ZeRO-1-style weight-update sharding (train/zero.py): momentum
+            # lives as one flat array sharded over ``data`` (1/R per chip).
+            # Checkpoints stay in the canonical per-leaf format either way.
+            if resident:
+                raise ValueError(
+                    "shard_update is not yet supported with the resident "
+                    "scan-per-epoch path; use the streaming path")
+            from .zero import init_opt_shard, pytree_to_opt_shard
+            opt = (pytree_to_opt_shard(self.state.opt_state.momentum_buf,
+                                       mesh)
+                   if self.start_epoch else init_opt_shard(params, mesh))
+            self.state = TrainState(self.state.params, self.state.batch_stats,
+                                    opt, self.state.step)
         self.resident = None
         if resident:
             # Device-resident path: dataset uploaded once, whole epoch as a
@@ -84,6 +100,11 @@ class Trainer:
             from .epoch import make_train_epoch
             self.resident = ResidentData(train_loader.dataset, mesh)
             self.train_epoch = make_train_epoch(
+                model, sgd_config, lr_schedule, mesh,
+                compute_dtype=compute_dtype, device_augment=device_augment)
+        elif shard_update:
+            from .zero import make_train_step_zero
+            self.train_step = make_train_step_zero(
                 model, sgd_config, lr_schedule, mesh,
                 compute_dtype=compute_dtype, device_augment=device_augment)
         else:
@@ -149,8 +170,19 @@ class Trainer:
                                       loss=loss, lr=float(lr))
 
     def _save_checkpoint(self, epoch: int) -> None:
+        # Canonical per-leaf momentum in the file regardless of the
+        # in-memory layout: snapshots interchange across modes.  The
+        # conversion is a COLLECTIVE under multi-host (all-gather of the
+        # sharded buffer), so every process runs it; only rank 0 writes.
+        opt_state = self.state.opt_state
+        if self.shard_update:
+            from .zero import opt_shard_to_pytree
+            opt_state = opt_shard_to_pytree(self.state.params, opt_state,
+                                            self.mesh)
+        if self.gpu_id != 0:  # reference rank-0 gate, multigpu.py:118
+            return
         save_checkpoint(self.snapshot_path, self.state.params,
-                        self.state.batch_stats, self.state.opt_state,
+                        self.state.batch_stats, opt_state,
                         int(self.state.step), epoch)
         # Reference print, singlegpu.py:122.
         print(f"Epoch {epoch} | Training checkpoint saved at "
@@ -163,6 +195,5 @@ class Trainer:
             self._run_epoch(epoch)
             # NB: like the reference, epoch 0 satisfies the modulo gate —
             # snapshot_path=None disables checkpointing entirely.
-            if (self.snapshot_path and self.gpu_id == 0
-                    and epoch % self.save_every == 0):
+            if self.snapshot_path and epoch % self.save_every == 0:
                 self._save_checkpoint(epoch)

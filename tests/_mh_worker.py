@@ -5,10 +5,12 @@ data`` batch assembly, shard_map train step, process-0 checkpoint write.
 
 Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path> [mode]
 
-``mode`` is ``streaming`` (default; per-step host-fed batches) or
+``mode`` is ``streaming`` (default; per-step host-fed batches),
 ``resident`` (HBM-resident dataset + scan-per-epoch: exercises
 ``make_array_from_process_local_data`` for the dataset upload and
-``put_index_matrix``'s local-column assembly across real processes).
+``put_index_matrix``'s local-column assembly across real processes), or
+``zero`` (weight-update sharding: exercises the cross-process momentum
+shard and the collective checkpoint canonicalisation in train/zero.py).
 """
 import os
 import sys
@@ -23,7 +25,7 @@ jax.config.update("jax_platforms", "cpu")
 
 def main() -> None:
     pid, coordinator, ckpt_path = (int(sys.argv[1]), sys.argv[2], sys.argv[3])
-    resident = len(sys.argv) > 4 and sys.argv[4] == "resident"
+    mode = sys.argv[4] if len(sys.argv) > 4 else "streaming"
     from ddp_tpu.parallel import dist
     dist.initialize(coordinator=coordinator, num_processes=2, process_id=pid)
     assert jax.process_count() == 2 and jax.device_count() == 8
@@ -48,7 +50,8 @@ def main() -> None:
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
                       save_every=1, snapshot_path=ckpt_path,
-                      resident=resident)
+                      resident=(mode == "resident"),
+                      shard_update=(mode == "zero"))
     trainer.train(2)  # process 0 writes the checkpoint (rank-0 gate)
     dist.shutdown()
 

@@ -60,7 +60,8 @@ def _run_and_compare(tmp_path, mode: str, *, rtol=1e-6, atol=1e-7) -> None:
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
                       save_every=100, snapshot_path=str(tmp_path / "sp.pt"),
-                      resident=(mode == "resident"))
+                      resident=(mode == "resident"),
+                      shard_update=(mode == "zero"))
     trainer.train(2)
 
     got = load_checkpoint(ckpt)
@@ -93,3 +94,12 @@ def test_two_process_resident_matches_single_process(tmp_path):
     out any indexing/assembly error — a wrong column mapping would show up
     as O(1) differences)."""
     _run_and_compare(tmp_path, "resident", rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_zero_matches_single_process(tmp_path):
+    """Weight-update sharding across real processes: the momentum buffer
+    spans both hosts' devices and the per-epoch checkpoint write forces the
+    collective canonicalisation path (train/zero.py:opt_shard_to_pytree) —
+    the exact surface a rank-0-only conversion would deadlock or crash on."""
+    _run_and_compare(tmp_path, "zero", rtol=1e-4, atol=1e-5)
