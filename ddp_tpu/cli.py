@@ -76,6 +76,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "each epoch as one jitted lax.scan: no per-step "
                         "host->device batch traffic or dispatch (implies "
                         "on-device augmentation)")
+    p.add_argument("--sync_bn", action="store_true",
+                   help="Synchronise BatchNorm statistics across replicas "
+                        "(the SyncBatchNorm line the reference keeps "
+                        "commented out, multigpu.py:127)")
     p.add_argument("--shard_update", action="store_true",
                    help="ZeRO-1-style weight-update sharding: "
                         "reduce-scatter grads, update a 1/R momentum+param "
@@ -233,7 +237,7 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       compute_dtype=compute_dtype, seed=args.seed,
                       resume=args.resume, metrics=metrics,
                       device_augment=device_augment, resident=args.resident,
-                      shard_update=args.shard_update)
+                      shard_update=args.shard_update, sync_bn=args.sync_bn)
 
     start = time.time()
     if args.profile_dir:

@@ -8,6 +8,7 @@ onto the MXU and fuse the elementwise BN/ReLU chains into them.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -16,6 +17,25 @@ from jax import lax
 
 # NHWC / HWIO are the layouts XLA:TPU convolutions are natively tiled for.
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+
+# Ambient mesh axis over which batch_norm synchronises its batch statistics
+# (the TPU-native SyncBatchNorm the reference keeps commented out,
+# multigpu.py:127).  A trace-time context rather than a per-call argument so
+# model code stays signature-identical whether BN is synced or not; the
+# step builders (train/step.py) set it from their sync_bn flag.
+_BN_SYNC_AXIS: Optional[str] = None
+
+
+@contextlib.contextmanager
+def bn_sync_axis(axis_name: Optional[str]):
+    """Within this context, training-mode batch_norm psums its statistics
+    over ``axis_name`` (must be inside shard_map over that axis)."""
+    global _BN_SYNC_AXIS
+    prev, _BN_SYNC_AXIS = _BN_SYNC_AXIS, axis_name
+    try:
+        yield
+    finally:
+        _BN_SYNC_AXIS = prev
 
 
 def conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array] = None,
@@ -93,11 +113,30 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     """
     if train:
         xf = x.astype(jnp.float32)
-        batch_mean = xf.mean(axis=(0, 1, 2))
-        batch_var = jnp.maximum(                # biased (1/n), to normalise
-            (xf * xf).mean(axis=(0, 1, 2)) - batch_mean * batch_mean, 0.0)
-        n = x.shape[0] * x.shape[1] * x.shape[2]
-        unbiased = batch_var * (n / max(n - 1, 1))
+        n = jnp.asarray(x.shape[0] * x.shape[1] * x.shape[2], jnp.float32)
+        if _BN_SYNC_AXIS is None:
+            batch_mean = xf.mean(axis=(0, 1, 2))
+            batch_var = jnp.maximum(  # one-pass biased var, to normalise
+                (xf * xf).mean(axis=(0, 1, 2)) - batch_mean * batch_mean,
+                0.0)
+        else:
+            # SyncBatchNorm: statistics over the GLOBAL batch (equal shard
+            # sizes inside shard_map, so means of per-shard means are
+            # exact).  The variance here is the *centered* two-pass form,
+            # not the one-pass E[x^2]-E[x]^2 used above: under cancellation
+            # (mean^2 >> var) the one-pass form amplifies the psum's
+            # rounding ~10x more than centering does (verified against an
+            # f64 reference).  Sync-BN is opt-in, so the extra read of x is
+            # an acceptable price for the better-conditioned statistics —
+            # the same choice torch's SyncBatchNorm makes.
+            r = lax.psum(jnp.ones((), jnp.float32), _BN_SYNC_AXIS)
+            batch_mean = lax.psum(xf.mean(axis=(0, 1, 2)),
+                                  _BN_SYNC_AXIS) / r
+            d = xf - batch_mean
+            batch_var = lax.psum((d * d).mean(axis=(0, 1, 2)),
+                                 _BN_SYNC_AXIS) / r
+            n = n * r
+        unbiased = batch_var * (n / jnp.maximum(n - 1.0, 1.0))
         new_state = BatchNormState(
             mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
             var=(1.0 - momentum) * state.var + momentum * unbiased,

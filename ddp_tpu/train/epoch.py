@@ -41,7 +41,7 @@ from .step import TrainState, _as_input, make_batch_core
 def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
                      lr_schedule: Callable[[jax.Array], jax.Array],
                      mesh: Mesh, compute_dtype=None,
-                     device_augment: bool = False):
+                     device_augment: bool = False, sync_bn: bool = False):
     """Build the jitted scan-per-epoch train function over ``mesh``.
 
     Returns ``epoch_fn(state, images, labels, idx, rng) -> (state, losses)``
@@ -55,7 +55,7 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
     singlegpu.py:179 semantics) compile once each and are cached by jit.
     """
     core = make_batch_core(model, sgd_config, lr_schedule,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype, sync_bn=sync_bn)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         def one_step(st, idx_row):

@@ -67,7 +67,7 @@ def init_train_state(params, batch_stats) -> TrainState:
 
 def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
-                    compute_dtype=None):
+                    compute_dtype=None, sync_bn: bool = False):
     """The per-batch training math, as a pure per-shard function.
 
     ``core(state, get_batch, rng) -> (state, loss)`` — everything the
@@ -91,10 +91,15 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
         images, labels = get_batch(jax.random.fold_in(rng, 1))
 
         def loss_fn(params):
-            logits, new_stats = model.apply(
-                params, state.batch_stats,
-                _as_input(images, compute_dtype), train=True,
-                rng=rng, compute_dtype=compute_dtype)
+            # sync_bn: BN statistics psum'd over the global batch — the
+            # SyncBatchNorm the reference leaves commented out
+            # (multigpu.py:127), as an opt-in (ops/layers.py:bn_sync_axis).
+            from ..ops.layers import bn_sync_axis
+            with bn_sync_axis(DATA_AXIS if sync_bn else None):
+                logits, new_stats = model.apply(
+                    params, state.batch_stats,
+                    _as_input(images, compute_dtype), train=True,
+                    rng=rng, compute_dtype=compute_dtype)
             ce_sum, count = cross_entropy_sum_count(logits, labels)
             # Global mean: psum(sum)/psum(count).  Equal per-shard counts
             # (DistributedSampler padding guarantee, multigpu.py:153) make
@@ -125,7 +130,7 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
 def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
                     mesh: Mesh, compute_dtype=None,
-                    device_augment: bool = False):
+                    device_augment: bool = False, sync_bn: bool = False):
     """Build the jitted SPMD train step for ``model`` over ``mesh``.
 
     Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
@@ -133,10 +138,11 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
     the mesh size, globally sharded on ``data``.  ``rng`` feeds dropout
     (DeepNN, singlegpu.py:36) and, with ``device_augment=True``, the
     on-device RandomCrop+HFlip (data/device_augment.py) — in that mode the
-    loader must be built with ``augment=False``.
+    loader must be built with ``augment=False``.  ``sync_bn=True`` syncs
+    BN statistics across shards (multigpu.py:127's commented-out option).
     """
     core = make_batch_core(model, sgd_config, lr_schedule,
-                           compute_dtype=compute_dtype)
+                           compute_dtype=compute_dtype, sync_bn=sync_bn)
 
     def _shard_body(state: TrainState, batch, rng):
         def get_batch(aug_rng):

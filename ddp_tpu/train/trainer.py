@@ -46,7 +46,8 @@ class Trainer:
                  metrics: Optional[MetricsLogger] = None,
                  device_augment: bool = False,
                  resident: bool = False,
-                 shard_update: bool = False):
+                 shard_update: bool = False,
+                 sync_bn: bool = False):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -71,6 +72,11 @@ class Trainer:
             self.start_epoch = ckpt.epoch + 1
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
         self.shard_update = shard_update
+        if sync_bn and shard_update:
+            # zero.py runs under check_vma=False, where the legacy psum
+            # transpose rule (psum -> psum) would silently scale the BN
+            # statistics' cotangents by the mesh size.
+            raise ValueError("sync_bn is not supported with shard_update")
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
             # lives as one flat array sharded over ``data`` (1/R per chip).
@@ -101,7 +107,8 @@ class Trainer:
             self.resident = ResidentData(train_loader.dataset, mesh)
             self.train_epoch = make_train_epoch(
                 model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment)
+                compute_dtype=compute_dtype, device_augment=device_augment,
+                sync_bn=sync_bn)
         elif shard_update:
             from .zero import make_train_step_zero
             self.train_step = make_train_step_zero(
@@ -110,7 +117,8 @@ class Trainer:
         else:
             self.train_step = make_train_step(
                 model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment)
+                compute_dtype=compute_dtype, device_augment=device_augment,
+                sync_bn=sync_bn)
 
     def _epoch_losses_streaming(self):
         """Per-step dispatch over host-fed batches (the reference's loop,
