@@ -76,6 +76,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "each epoch as one jitted lax.scan: no per-step "
                         "host->device batch traffic or dispatch (implies "
                         "on-device augmentation)")
+    p.add_argument("--grad_accum", type=int, default=1, metavar="A",
+                   help="Accumulate gradients over A micro-batches per "
+                        "optimizer step (one jitted scan; effective batch "
+                        "= A * --batch_size per replica)")
     p.add_argument("--sync_bn", action="store_true",
                    help="Synchronise BatchNorm statistics across replicas "
                         "(the SyncBatchNorm line the reference keeps "
@@ -226,8 +230,12 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     # Triangular schedule (reference singlegpu.py:142-149) with
     # steps_per_epoch derived from the real shard size and the triangle span
     # tied to the CLI epoch count — the two sanctioned fixes to the
-    # reference's hardcoded 98/49 and 20 (SURVEY.md appendix).
-    lr_schedule = build_schedule(args, len(train_loader))
+    # reference's hardcoded 98/49 and 20 (SURVEY.md appendix).  Under
+    # gradient accumulation the schedule counts OPTIMIZER steps (one per
+    # group of --grad_accum micro-batches), matching torch's
+    # scheduler.step()-after-optimizer.step() convention.
+    opt_steps = -(-len(train_loader) // max(args.grad_accum, 1))
+    lr_schedule = build_schedule(args, opt_steps)
 
     metrics = MetricsLogger(args.metrics_path)
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
@@ -237,7 +245,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       compute_dtype=compute_dtype, seed=args.seed,
                       resume=args.resume, metrics=metrics,
                       device_augment=device_augment, resident=args.resident,
-                      shard_update=args.shard_update, sync_bn=args.sync_bn)
+                      shard_update=args.shard_update, sync_bn=args.sync_bn,
+                      grad_accum=args.grad_accum)
 
     start = time.time()
     if args.profile_dir:

@@ -80,6 +80,9 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
     strategies cannot drift numerically (pinned by tests/test_resident.py).
     """
 
+    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
+                                         sync_bn=sync_bn)
+
     def core(state: TrainState, get_batch, rng):
         # Per-step, per-shard RNG so dropout masks differ across steps and
         # across replicas' data shards; the caller passes one constant key.
@@ -89,7 +92,24 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
         # draws from the same key, so per-step and resident paths augment
         # bit-identically.
         images, labels = get_batch(jax.random.fold_in(rng, 1))
+        loss, new_stats, grads = loss_and_grads(
+            state.params, state.batch_stats, images, labels, rng)
+        lr_t = lr_schedule(state.step)
+        params, opt_state = sgd_lib.apply_updates(
+            state.params, grads, state.opt_state, lr_t, sgd_config)
+        return TrainState(params, new_stats, opt_state, state.step + 1), loss
 
+    return core
+
+
+def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
+    """The forward/backward alone (no optimizer update), per shard:
+    ``fn(params, batch_stats, images, labels, rng) -> (loss, stats, grads)``
+    — shared between the plain step (make_batch_core) and the
+    gradient-accumulation step (make_train_step_accum), so the two cannot
+    drift numerically."""
+
+    def loss_and_grads(params, batch_stats, images, labels, rng):
         def loss_fn(params):
             # sync_bn: BN statistics psum'd over the global batch — the
             # SyncBatchNorm the reference leaves commented out
@@ -97,7 +117,7 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
             from ..ops.layers import bn_sync_axis
             with bn_sync_axis(DATA_AXIS if sync_bn else None):
                 logits, new_stats = model.apply(
-                    params, state.batch_stats,
+                    params, batch_stats,
                     _as_input(images, compute_dtype), train=True,
                     rng=rng, compute_dtype=compute_dtype)
             ce_sum, count = cross_entropy_sum_count(logits, labels)
@@ -109,7 +129,7 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
             return loss, new_stats
 
         (loss, new_stats), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+            loss_fn, has_aux=True)(params)
         # No explicit gradient collective: differentiating w.r.t. the
         # replicated (in_specs=P()) params makes shard_map's autodiff insert
         # the psum over ``data`` itself (the transpose of replication —
@@ -119,12 +139,9 @@ def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
         # (tests/test_train_step.py pins this numerically).
         new_stats = jax.tree_util.tree_map(
             lambda s: lax.pmean(s, DATA_AXIS), new_stats)
-        lr_t = lr_schedule(state.step)
-        params, opt_state = sgd_lib.apply_updates(
-            state.params, grads, state.opt_state, lr_t, sgd_config)
-        return TrainState(params, new_stats, opt_state, state.step + 1), loss
+        return loss, new_stats, grads
 
-    return core
+    return loss_and_grads
 
 
 def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
@@ -162,6 +179,67 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
     rep = replicated_sharding(mesh)
     return jax.jit(mapped, donate_argnums=(0,),
                    out_shardings=(rep, rep))
+
+
+def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
+                          lr_schedule: Callable[[jax.Array], jax.Array],
+                          mesh: Mesh, compute_dtype=None,
+                          device_augment: bool = False,
+                          sync_bn: bool = False):
+    """Gradient accumulation: one optimizer step over A stacked
+    micro-batches (torch's no_sync()+step-every-A, TPU-shaped).
+
+    ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch`` arrays
+    are ``[A, B, ...]`` — A micro-batches of global batch B, sharded on the
+    batch (second) axis.  Inside the jitted program a ``lax.scan`` runs the
+    shared forward/backward (make_loss_and_grads) per micro-batch,
+    averaging gradients; BN running stats chain through the micro-batches
+    in order (each forward normalises with its own micro-batch statistics,
+    exactly like torch under accumulation); ONE SGD update at lr(step)
+    follows.  Distinct A values (a ragged tail group) compile once each.
+    ``loss`` is the mean of the micro-batch global-mean losses.
+    """
+    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
+                                         sync_bn=sync_bn)
+
+    def _shard_body(state: TrainState, batch, rng):
+        rng = jax.random.fold_in(rng, state.step)
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+
+        def one_micro(carry, micro):
+            stats, gsum, lsum, k = carry
+            mrng = jax.random.fold_in(rng, k)
+            images = micro["image"]
+            if device_augment:
+                from ..data.device_augment import random_crop_flip
+                images = random_crop_flip(jax.random.fold_in(mrng, 1),
+                                          images)
+            loss, stats, grads = loss_and_grads(
+                state.params, stats, images, micro["label"], mrng)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (stats, gsum, lsum + loss, k + 1), None
+
+        a = batch["label"].shape[0]
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        (new_stats, gsum, lsum, _), _ = lax.scan(
+            one_micro, (state.batch_stats, zeros, jnp.zeros(()),
+                        jnp.zeros((), jnp.int32)), batch)
+        grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
+        loss = lsum / a
+        lr_t = lr_schedule(state.step)
+        params, opt_state = sgd_lib.apply_updates(
+            state.params, grads, state.opt_state, lr_t, sgd_config)
+        return (TrainState(params, new_stats, opt_state, state.step + 1),
+                loss)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(P(), {"image": P(None, DATA_AXIS),
+                        "label": P(None, DATA_AXIS)}, P()),
+        out_specs=(P(), P()),
+    )
+    rep = replicated_sharding(mesh)
+    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
 
 
 def make_eval_step(model, mesh: Mesh, compute_dtype=None):
@@ -203,6 +281,16 @@ def shard_batch(batch: dict, mesh: Mesh) -> dict:
     each DDP rank feeding its own DistributedSampler shard.
     """
     sharding = batch_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(batch, sharding)
+    return {k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in batch.items()}
+
+
+def shard_batch_stacked(batch: dict, mesh: Mesh) -> dict:
+    """Like :func:`shard_batch` for ``[A, B, ...]`` micro-batch stacks
+    (make_train_step_accum): sharded on the batch (second) axis."""
+    sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
     return {k: jax.make_array_from_process_local_data(sharding, v)

@@ -35,6 +35,29 @@ from .checkpoint import load_checkpoint, save_checkpoint
 from .step import TrainState, init_train_state, make_train_step, shard_batch
 
 
+def _stack_groups(batches, accum: int):
+    """Group consecutive same-shaped host batches into ``[A, B, ...]``
+    stacks of up to ``accum`` for the accumulation step.  The epoch's final
+    ragged batch (different B) cannot join a full-batch stack, so a shape
+    change flushes the current group — it becomes its own (smaller) final
+    optimizer step, mirroring drop_last=False semantics."""
+    group: list = []
+
+    def flush():
+        out = {k: np.stack([b[k] for b in group]) for k in group[0]}
+        group.clear()
+        return out
+
+    for b in batches:
+        if group and len(b["label"]) != len(group[0]["label"]):
+            yield flush()
+        group.append(b)
+        if len(group) == accum:
+            yield flush()
+    if group:
+        yield flush()
+
+
 class Trainer:
     def __init__(self, model, train_loader, params, batch_stats, *,
                  mesh, lr_schedule: Callable,
@@ -47,7 +70,8 @@ class Trainer:
                  device_augment: bool = False,
                  resident: bool = False,
                  shard_update: bool = False,
-                 sync_bn: bool = False):
+                 sync_bn: bool = False,
+                 grad_accum: int = 1):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -72,6 +96,11 @@ class Trainer:
             self.start_epoch = ckpt.epoch + 1
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
         self.shard_update = shard_update
+        self.grad_accum = max(grad_accum, 1)
+        if self.grad_accum > 1 and (resident or shard_update):
+            raise ValueError(
+                "grad_accum > 1 is supported on the streaming replicated "
+                "path only (not with resident or shard_update)")
         if sync_bn and shard_update:
             # zero.py runs under check_vma=False, where the legacy psum
             # transpose rule (psum -> psum) would silently scale the BN
@@ -114,6 +143,12 @@ class Trainer:
             self.train_step = make_train_step_zero(
                 model, sgd_config, lr_schedule, mesh,
                 compute_dtype=compute_dtype, device_augment=device_augment)
+        elif self.grad_accum > 1:
+            from .step import make_train_step_accum
+            self.train_step = make_train_step_accum(
+                model, sgd_config, lr_schedule, mesh,
+                compute_dtype=compute_dtype, device_augment=device_augment,
+                sync_bn=sync_bn)
         else:
             self.train_step = make_train_step(
                 model, sgd_config, lr_schedule, mesh,
@@ -124,6 +159,17 @@ class Trainer:
         """Per-step dispatch over host-fed batches (the reference's loop,
         multigpu.py:104-107)."""
         epoch_losses = []
+        if self.grad_accum > 1:
+            # One dispatch per GROUP of grad_accum micro-batches; the
+            # scanned accumulation amortises the per-dispatch overhead A-x,
+            # so no prefetch thread is needed here.
+            from .step import shard_batch_stacked
+            for group in _stack_groups(self.train_loader, self.grad_accum):
+                device_batch = shard_batch_stacked(group, self.mesh)
+                self.state, loss = self.train_step(
+                    self.state, device_batch, self.rng)
+                epoch_losses.append(loss)
+            return jnp.stack(epoch_losses) if epoch_losses else None
         # Background thread augments + device_puts ahead of the loop (the
         # pin_memory/worker analogue, singlegpu.py:177); combined with JAX
         # async dispatch the chips never wait on the host in steady state.
