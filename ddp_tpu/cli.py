@@ -76,6 +76,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "each epoch as one jitted lax.scan: no per-step "
                         "host->device batch traffic or dispatch (implies "
                         "on-device augmentation)")
+    p.add_argument("--eval_every", type=int, default=0, metavar="E",
+                   help="Evaluate on the test set every E epochs during "
+                        "training (0 = only the reference's single "
+                        "end-of-run eval)")
     p.add_argument("--grad_accum", type=int, default=1, metavar="A",
                    help="Accumulate gradients over A micro-batches per "
                         "optimizer step (one jitted scan; effective batch "
@@ -248,10 +252,33 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       shard_update=args.shard_update, sync_bn=args.sync_bn,
                       grad_accum=args.grad_accum)
 
+    eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
+                             local_replicas=local_replicas)
+
+    def _eval(progress: bool) -> float:
+        if args.resident:
+            from .data.resident import ResidentData
+            from .train.evaluate import evaluate_resident
+            return evaluate_resident(
+                model, trainer.state.params, trainer.state.batch_stats,
+                ResidentData(test_ds, mesh), eval_loader, mesh)
+        return evaluate(model, trainer.state.params,
+                        trainer.state.batch_stats, eval_loader, mesh,
+                        progress=progress)
+
+    def _epoch_callback(epoch: int) -> None:
+        # --eval_every: periodic validation (no reference analogue — it
+        # evaluates once, after training, multigpu.py:247).
+        if args.eval_every and (epoch + 1) % args.eval_every == 0:
+            acc = _eval(progress=False)
+            print(f"Epoch {epoch} | eval accuracy={acc:.2f}%")
+            metrics.log_eval(epoch=epoch, accuracy=acc)
+
     start = time.time()
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
-    trainer.train(args.total_epochs)
+    trainer.train(args.total_epochs,
+                  epoch_callback=_epoch_callback if args.eval_every else None)
     if args.profile_dir:
         jax.profiler.stop_trace()
     training_time = time.time() - start
@@ -262,17 +289,7 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     print(f"fp32 model has size={fp32_model_size/MiB:.2f} MiB")
     if args.export_torch and jax.process_index() == 0:
         _export_torch(args.model, args.export_torch, trainer)
-    eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
-                             local_replicas=local_replicas)
-    if args.resident:
-        from .data.resident import ResidentData
-        from .train.evaluate import evaluate_resident
-        accuracy = evaluate_resident(
-            model, trainer.state.params, trainer.state.batch_stats,
-            ResidentData(test_ds, mesh), eval_loader, mesh)
-    else:
-        accuracy = evaluate(model, trainer.state.params,
-                            trainer.state.batch_stats, eval_loader, mesh)
+    accuracy = _eval(progress=True)  # reference's tqdm bar, multigpu.py:190
     print(f"fp32 model has accuracy={accuracy:.2f}%")
     dist.shutdown()  # reference destroy_process_group (multigpu.py:250)
     return accuracy

@@ -242,12 +242,16 @@ class Trainer:
         print(f"Epoch {epoch} | Training checkpoint saved at "
               f"{self.snapshot_path}")
 
-    def train(self, max_epochs: int) -> None:
+    def train(self, max_epochs: int, epoch_callback=None) -> None:
         """Reference ``Trainer.train`` (multigpu.py:115-119): epoch loop with
-        the rank-0 ``save_every`` checkpoint gate."""
+        the rank-0 ``save_every`` checkpoint gate.  ``epoch_callback(epoch)``
+        runs after each epoch's checkpoint gate (used for --eval_every;
+        no reference analogue)."""
         for epoch in range(self.start_epoch, max_epochs):
             self._run_epoch(epoch)
             # NB: like the reference, epoch 0 satisfies the modulo gate —
             # snapshot_path=None disables checkpointing entirely.
             if self.snapshot_path and epoch % self.save_every == 0:
                 self._save_checkpoint(epoch)
+            if epoch_callback is not None:
+                epoch_callback(epoch)

@@ -34,6 +34,16 @@ class MetricsLogger:
             "lr": round(lr, 8), "wall_s": round(time.time() - self._t0, 3),
         }) + "\n")
 
+    def log_eval(self, *, epoch: int, accuracy: float) -> None:
+        """Periodic-eval record (--eval_every; absent in the reference,
+        which evaluates once after training — multigpu.py:247)."""
+        if self._f is None:
+            return
+        self._f.write(json.dumps({
+            "epoch": epoch, "eval_accuracy": round(accuracy, 4),
+            "wall_s": round(time.time() - self._t0, 3),
+        }) + "\n")
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()

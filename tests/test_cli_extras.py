@@ -75,3 +75,25 @@ def test_graft_entry_hooks():
     assert logits.shape == (8, 10)
     ge.dryrun_multichip(2)
     ge.dryrun_multichip(8)
+
+
+def test_eval_every(tmp_path, capsys, monkeypatch):
+    """--eval_every E: periodic validation line + JSONL record per E epochs
+    (the reference evaluates exactly once, after training)."""
+    import json
+
+    from ddp_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    parser = cli.build_parser("test")
+    args = parser.parse_args(
+        ["2", "5", "--batch_size", "8", "--synthetic", "--model", "deepnn",
+         "--lr", "0.05", "--num_devices", "2", "--synthetic_size", "32",
+         "--eval_every", "1", "--metrics_path", "m.jsonl"])
+    cli.run(args, num_devices=None)
+    out = capsys.readouterr().out
+    assert "Epoch 0 | eval accuracy=" in out
+    assert "Epoch 1 | eval accuracy=" in out
+    evals = [json.loads(l) for l in open("m.jsonl")
+             if "eval_accuracy" in l]
+    assert [e["epoch"] for e in evals] == [0, 1]
