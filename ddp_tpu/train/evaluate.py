@@ -8,7 +8,6 @@ running stats (``model.eval()`` semantics, singlegpu.py:189).
 """
 from __future__ import annotations
 
-import jax
 
 from .step import make_eval_step, shard_batch
 

@@ -29,8 +29,7 @@ normalisation is unaffected (it uses batch stats).
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

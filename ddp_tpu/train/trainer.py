@@ -32,7 +32,7 @@ from ..optim.sgd import SGDConfig
 from ..parallel import dist
 from ..utils.metrics import MetricsLogger
 from .checkpoint import load_checkpoint, save_checkpoint
-from .step import TrainState, init_train_state, make_train_step, shard_batch
+from .step import TrainState, init_train_state, make_train_step
 
 
 def _stack_groups(batches, accum: int):

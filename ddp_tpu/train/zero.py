@@ -28,7 +28,7 @@ reduce-*scatter* instead.  Every collective here is therefore explicit.
 """
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
