@@ -1,0 +1,92 @@
+"""Batched row gather — the resident data path's hot op, as a Pallas kernel.
+
+``table[idx]`` for a [M, ...] uint8 dataset table is the core of the
+HBM-resident input path (train/epoch.py): every step gathers its batch by
+index from the resident array.  XLA:TPU lowers that advanced-indexing
+gather to a slow generic gather (~4.7 ms for 512 rows of 3 KB on v5e —
+9 us/row, latency-bound); this kernel instead drives one DMA per row
+through the Pallas pipeline with scalar-prefetched indices (the index_map
+reads ``idx`` before the body runs, so block fetches double-buffer), which
+measures ~1.1 ms for the same gather — ~4x faster, and ~20% off the whole
+resident train step.
+
+Non-TPU backends (the CPU test mesh) use the plain XLA gather — identical
+values, so every numerical test covers both paths' semantics.  Override
+with DDP_TPU_PALLAS=0 to force the XLA path on TPU.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+_LANE = 128
+
+
+def _use_pallas() -> bool:
+    return (jax.default_backend() == "tpu"
+            and os.environ.get("DDP_TPU_PALLAS", "1") != "0")
+
+
+def _copy_kernel(idx_ref, in_ref, out_ref):
+    del idx_ref  # consumed by the index_map, not the body
+    out_ref[...] = in_ref[...]
+
+
+def _pallas_row_gather(table2d: jax.Array, idx: jax.Array) -> jax.Array:
+    """[M, D] (D % 128 == 0), int32 [N] -> [N, D] == table2d[idx]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, d = table2d.shape
+    n = idx.shape[0]
+    sub = d // _LANE
+    t3 = table2d.reshape(m, sub, _LANE)
+    # Block (1, sub, LANE): the last two dims equal the array dims, which
+    # satisfies the Mosaic block-shape constraint for any D % 128 == 0.
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, sub, _LANE),
+                               lambda i, idx_ref: (idx_ref[i], 0, 0))],
+        out_specs=pl.BlockSpec((1, sub, _LANE),
+                               lambda i, idx_ref: (i, 0, 0)),
+    )
+    # Inside shard_map (check_vma=True) the output's varying-axes type must
+    # be declared: the gathered rows vary wherever the indices or the table
+    # do (the idx matrix is sharded on ``data``; the table is replicated).
+    try:
+        vma = frozenset(jax.typeof(idx).vma) | frozenset(
+            jax.typeof(table2d).vma)
+    except AttributeError:
+        vma = None
+    out_shape = (jax.ShapeDtypeStruct((n, sub, _LANE), table2d.dtype,
+                                      vma=vma)
+                 if vma is not None
+                 else jax.ShapeDtypeStruct((n, sub, _LANE), table2d.dtype))
+    out = pl.pallas_call(
+        _copy_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+    )(idx, t3)
+    return out.reshape(n, d)
+
+
+def gather_rows(table: jax.Array, idx: jax.Array) -> jax.Array:
+    """``table[idx]`` along axis 0, via the Pallas DMA kernel when the row
+    byte-count allows (TPU, row size a multiple of 128 elements), else the
+    plain XLA gather.  Values are identical either way."""
+    n = idx.shape[0]
+    row_shape = table.shape[1:]
+    d = 1
+    for s in row_shape:
+        d *= s
+    if _use_pallas() and d % _LANE == 0:
+        # Clamp like XLA's gather does: an out-of-range block index in the
+        # Pallas index_map would be undefined behaviour (OOB DMA), not the
+        # clamped read the fallback path gives.
+        idx = jnp.clip(idx.astype(jnp.int32), 0, table.shape[0] - 1)
+        flat = _pallas_row_gather(table.reshape(table.shape[0], d), idx)
+        return flat.reshape((n,) + row_shape)
+    return table[idx]

@@ -61,12 +61,13 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
         def one_step(st, idx_row):
             def get_batch(aug_rng):
                 if device_augment:
-                    # Dataset gather, zero-pad, crop and flip as ONE gather
-                    # from the resident table — no intermediates.
+                    # Pallas DMA row gather + one-hot-matmul crop/flip
+                    # (data/device_augment.py, ops/gather.py).
                     from ..data.device_augment import gather_crop_flip
                     return (gather_crop_flip(aug_rng, images, idx_row),
                             labels[idx_row])
-                return images[idx_row], labels[idx_row]
+                from ..ops.gather import gather_rows
+                return gather_rows(images, idx_row), labels[idx_row]
 
             return core(st, get_batch, rng)
 
@@ -94,10 +95,13 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
     """
 
     def _shard_body(params, batch_stats, images, labels, idx, mask):
+        from ..ops.gather import gather_rows
+
         def one_step(carry, xs):
             idx_row, mask_row = xs
             logits, _ = model.apply(params, batch_stats,
-                                    _as_input(images[idx_row], compute_dtype),
+                                    _as_input(gather_rows(images, idx_row),
+                                              compute_dtype),
                                     train=False, compute_dtype=compute_dtype)
             pred = jnp.argmax(logits, axis=-1)
             hit = (pred == labels[idx_row]).astype(jnp.float32)
