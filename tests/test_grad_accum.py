@@ -138,3 +138,32 @@ def test_trainer_grad_accum_end_to_end():
     assert len(tr.loss_history) == 3
     assert int(tr.state.step) == 3
     assert all(np.isfinite(l) for l in tr.loss_history)
+
+
+def test_accum_with_device_augment():
+    """grad_accum composes with on-device augmentation: finite losses,
+    correct optimizer-step count, and a trajectory distinct from the
+    unaugmented one (the augmentation is actually applied per micro)."""
+    train_ds, _ = synthetic(n_train=64, seed=5)
+    mesh = make_mesh(2)
+    model = get_model("deepnn")
+    sched = functools.partial(triangular_lr, base_lr=0.05, num_epochs=1,
+                              steps_per_epoch=2)
+
+    def run(device_augment):
+        params, stats = model.init(jax.random.key(0))
+        loader = TrainLoader(train_ds, per_replica_batch=8, num_replicas=2,
+                             augment=False, seed=1)
+        tr = Trainer(model, loader, params, stats, mesh=mesh,
+                     lr_schedule=sched, sgd_config=SGDConfig(lr=0.05),
+                     save_every=10**9, snapshot_path=None, grad_accum=2,
+                     device_augment=device_augment)
+        tr.train(1)
+        return tr
+
+    aug, plain = run(True), run(False)
+    assert len(aug.loss_history) == 2 and int(aug.state.step) == 2
+    assert all(np.isfinite(l) for l in aug.loss_history)
+    # The crop/flip changes the inputs, so the trajectories cannot be
+    # identical (the magnitude is tiny after 2 steps — measured ~1e-6).
+    assert aug.loss_history != plain.loss_history

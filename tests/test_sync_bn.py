@@ -79,3 +79,37 @@ def test_unsynced_bn_differs_across_sharding():
     _, l1 = _run_steps(1, sync_bn=False)
     _, l8 = _run_steps(8, sync_bn=False)
     assert abs(l8[1] - l1[1]) > 1e-4, (l1, l8)
+
+
+def test_sync_bn_resident_matches_streaming():
+    """sync_bn composes with the resident scan-per-epoch path: same core
+    (make_batch_core) => same trajectory as streaming sync-BN."""
+    import functools
+
+    from ddp_tpu.data import TrainLoader, synthetic
+    from ddp_tpu.optim import SGDConfig
+    from ddp_tpu.train import Trainer
+    from ddp_tpu.optim import triangular_lr
+
+    def run(resident):
+        train_ds, _ = synthetic(n_train=64, n_test=16)
+        mesh = make_mesh(2)
+        model = get_model("vgg")
+        params, stats = model.init(jax.random.key(3))
+        loader = TrainLoader(train_ds, 8, 2, seed=3, augment=False)
+        sched = functools.partial(triangular_lr, base_lr=0.02, num_epochs=1,
+                                  steps_per_epoch=len(loader))
+        tr = Trainer(model, loader, params, stats, mesh=mesh,
+                     lr_schedule=sched, sgd_config=SGDConfig(lr=0.02),
+                     save_every=10**9, snapshot_path=None, seed=3,
+                     sync_bn=True, resident=resident, device_augment=True)
+        tr.train(1)
+        return tr
+
+    a, b = run(False), run(True)
+    # Both paths device-augment with the same folded keys, so the
+    # trajectories agree (same bounds as tests/test_resident.py).
+    np.testing.assert_allclose(a.loss_history[:2], b.loss_history[:2],
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(a.loss_history, b.loss_history,
+                               rtol=2e-3, atol=2e-3)
