@@ -7,7 +7,7 @@ avoidable for a dataset the size of CIFAR-10 (~180 MB uint8 — noise next to
 HBM): keep the *entire* training set resident on device
 (data/resident.py), upload only the epoch's sample-index matrix (~200 KB),
 and run the epoch as a single jitted ``shard_map`` program whose body is
-``lax.scan`` over :func:`~ddp_tpu.train.step.make_batch_core` — the exact
+``lax.scan`` over the shared per-step body (:func:`~ddp_tpu.train.step.make_group_step`) — the exact
 same per-batch math the per-step path runs, so the two strategies are
 bit-identical (pinned by tests/test_resident.py).
 
@@ -35,7 +35,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..parallel.mesh import DATA_AXIS, replicated_sharding
-from .step import TrainState, _as_input, make_batch_core
+from .step import (TrainState, _as_input, make_accum_scan, make_group_step,
+                   make_group_update, make_loss_and_grads, make_single_micro,
+                   micro_from_table)
 
 
 def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
@@ -54,28 +56,67 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
     Distinct ``idx`` shapes (e.g. the ragged final batch, 50000 % 512 != 0 —
     singlegpu.py:179 semantics) compile once each and are cached by jit.
     """
-    core = make_batch_core(model, sgd_config, lr_schedule,
-                           compute_dtype=compute_dtype, sync_bn=sync_bn)
+    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
+                                         sync_bn=sync_bn)
+    update = make_group_update(sgd_config, lr_schedule)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
-        def one_step(st, idx_row):
-            def get_batch(aug_rng):
-                if device_augment:
-                    # Pallas DMA row gather + one-hot-matmul crop/flip
-                    # (data/device_augment.py, ops/gather.py).
-                    from ..data.device_augment import gather_crop_flip
-                    return (gather_crop_flip(aug_rng, images, idx_row),
-                            labels[idx_row])
-                from ..ops.gather import gather_rows
-                return gather_rows(images, idx_row), labels[idx_row]
-
-            return core(st, get_batch, rng)
-
-        return lax.scan(one_step, state, idx)
+        group = make_group_step(
+            make_single_micro(loss_and_grads,
+                              micro_from_table(images, labels,
+                                               device_augment)),
+            update)
+        return lax.scan(lambda st, idx_row: group(st, idx_row, rng),
+                        state, idx)
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
         in_specs=(P(), P(), P(), P(None, DATA_AXIS), P()),
+        out_specs=(P(), P()),
+    )
+    rep = replicated_sharding(mesh)
+    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
+
+
+def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
+                           lr_schedule: Callable[[jax.Array], jax.Array],
+                           mesh: Mesh, compute_dtype=None,
+                           device_augment: bool = False,
+                           sync_bn: bool = False):
+    """Scan-per-epoch training WITH gradient accumulation: ``--resident``
+    composed with ``--grad_accum``.
+
+    Returns ``epoch_fn(state, images, labels, idx, rng) -> (state, losses)``
+    where ``idx`` is int32 ``[G, A, global_batch]`` — G optimizer-step
+    groups of A micro-batches each, sharded on the last (batch) axis.  The
+    outer ``lax.scan`` runs one optimizer step per group; the inner scan
+    accumulates gradients over the group's micro-batches with BN stats
+    chained in micro-batch order, exactly the semantics of the streaming
+    accumulation step (:func:`~ddp_tpu.train.step.make_train_step_accum`,
+    torch's no_sync()+step-every-A) — and the identical RNG fold structure,
+    so the two execution strategies produce the same trajectory (pinned by
+    tests/test_resident.py).  ``losses[g]`` is the mean of group g's
+    micro-batch global-mean losses.
+
+    Ragged groups (the epoch's remainder of full batches, and the final
+    ragged batch — drop_last=False, singlegpu.py:179) arrive as separate
+    calls with their own ``[1, A', B']`` shapes; each distinct shape
+    compiles once.
+    """
+    accum = make_accum_scan(make_loss_and_grads(
+        model, compute_dtype=compute_dtype, sync_bn=sync_bn))
+    update = make_group_update(sgd_config, lr_schedule)
+
+    def _shard_body(state: TrainState, images, labels, idx, rng):
+        get_micro = micro_from_table(images, labels, device_augment)
+        group = make_group_step(
+            lambda p, s, xs, g: accum(p, s, xs, get_micro, g), update)
+        return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
+                        state, idx)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(P(), P(), P(), P(None, None, DATA_AXIS), P()),
         out_specs=(P(), P()),
     )
     rep = replicated_sharding(mesh)
@@ -127,15 +168,16 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
 
 
 def put_index_matrix(idx: np.ndarray, mesh: Mesh) -> jax.Array:
-    """Host ``[steps, B]`` matrix (indices or masks) -> device array sharded
-    on axis 1 (the batch axis).
+    """Host ``[steps, B]`` (or ``[G, A, B]`` for the accumulation epoch)
+    matrix of indices or masks -> device array sharded on its LAST axis
+    (the batch axis).
 
     Multi-host: each process passes the columns for its own replicas (the
     per-host slice the loader materialises) and the global matrix is
     assembled process-locally — the index-only analogue of
     :func:`~ddp_tpu.train.step.shard_batch`.
     """
-    sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    sharding = NamedSharding(mesh, P(*([None] * (idx.ndim - 1)), DATA_AXIS))
     idx = np.ascontiguousarray(idx)
     if jax.process_count() == 1:
         return jax.device_put(idx, sharding)

@@ -64,49 +64,12 @@ def init_train_state(params, batch_stats) -> TrainState:
                       jnp.zeros((), jnp.int32))
 
 
-def make_batch_core(model, sgd_config: sgd_lib.SGDConfig,
-                    lr_schedule: Callable[[jax.Array], jax.Array],
-                    compute_dtype=None, sync_bn: bool = False):
-    """The per-batch training math, as a pure per-shard function.
-
-    ``core(state, get_batch, rng) -> (state, loss)`` — everything the
-    reference's ``Trainer._run_batch`` does (multigpu.py:92-98), written to
-    run *inside* a ``shard_map`` over the ``data`` axis.  ``get_batch(rng)
-    -> (images, labels)`` lets each caller materialise the batch its own
-    way (per-step: the incoming sharded batch, optionally device-augmented;
-    resident epoch: a fused gather+augment from the HBM-resident dataset)
-    while the training math stays shared verbatim — the two execution
-    strategies cannot drift numerically (pinned by tests/test_resident.py).
-    """
-
-    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
-                                         sync_bn=sync_bn)
-
-    def core(state: TrainState, get_batch, rng):
-        # Per-step, per-shard RNG so dropout masks differ across steps and
-        # across replicas' data shards; the caller passes one constant key.
-        rng = jax.random.fold_in(rng, state.step)
-        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-        # fold_in(rng, 1) is the augmentation stream: every batch provider
-        # draws from the same key, so per-step and resident paths augment
-        # bit-identically.
-        images, labels = get_batch(jax.random.fold_in(rng, 1))
-        loss, new_stats, grads = loss_and_grads(
-            state.params, state.batch_stats, images, labels, rng)
-        lr_t = lr_schedule(state.step)
-        params, opt_state = sgd_lib.apply_updates(
-            state.params, grads, state.opt_state, lr_t, sgd_config)
-        return TrainState(params, new_stats, opt_state, state.step + 1), loss
-
-    return core
-
-
 def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
     """The forward/backward alone (no optimizer update), per shard:
     ``fn(params, batch_stats, images, labels, rng) -> (loss, stats, grads)``
-    — shared between the plain step (make_batch_core) and the
-    gradient-accumulation step (make_train_step_accum), so the two cannot
-    drift numerically."""
+    — the single core every execution strategy's step is assembled from
+    (via :func:`make_single_micro` / :func:`make_accum_scan` +
+    :func:`make_group_step`), so the strategies cannot drift numerically."""
 
     def loss_and_grads(params, batch_stats, images, labels, rng):
         def loss_fn(params):
@@ -143,6 +106,133 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
     return loss_and_grads
 
 
+def _micro_from_batch(device_augment: bool):
+    """``get_micro`` for streaming paths: the micro-batch IS the scanned
+    ``{"image", "label"}`` dict, optionally device-augmented."""
+
+    def get_micro(aug_rng, micro):
+        images = micro["image"]
+        if device_augment:
+            from ..data.device_augment import random_crop_flip
+            images = random_crop_flip(aug_rng, images)
+        return images, micro["label"]
+
+    return get_micro
+
+
+def micro_from_table(images, labels, device_augment: bool):
+    """``get_micro`` for device-resident paths: the scanned value is an
+    index row into the HBM-resident dataset (Pallas DMA gather,
+    ops/gather.py; fused gather+crop+flip under device augmentation)."""
+
+    def get_micro(aug_rng, idx_row):
+        if device_augment:
+            from ..data.device_augment import gather_crop_flip
+            return gather_crop_flip(aug_rng, images, idx_row), labels[idx_row]
+        from ..ops.gather import gather_rows
+        return gather_rows(images, idx_row), labels[idx_row]
+
+    return get_micro
+
+
+def make_single_micro(loss_and_grads, get_micro):
+    """Adapt a per-micro core to :func:`make_group_step`'s ``group_grads``
+    signature for the non-accumulating paths: one micro-batch IS the whole
+    optimizer step.  ``fold_in(rng, 1)`` is the augmentation stream — every
+    batch provider (streaming dict, resident index row) draws from the same
+    key, so per-step and resident paths augment bit-identically."""
+
+    def group_grads(params, stats, xs, rng):
+        images, labels = get_micro(jax.random.fold_in(rng, 1), xs)
+        loss, new_stats, grads = loss_and_grads(params, stats, images,
+                                                labels, rng)
+        return new_stats, grads, loss
+
+    return group_grads
+
+
+def make_accum_scan(loss_and_grads):
+    """The shared micro-batch accumulation scaffold — ONE implementation of
+    the inner scan that every ``grad_accum`` variant uses (streaming /
+    resident x replicated / sharded update), so the accumulation semantics
+    (RNG fold structure, BN-stats chaining, gradient averaging) cannot
+    drift between flag combinations.
+
+    ``loss_and_grads(params, stats, images, labels, rng) -> (loss, stats,
+    grads)`` is the per-micro forward/backward
+    (:func:`make_loss_and_grads` or the zero path's local-grads core).
+    Returns ``accum(params, stats, xs, get_micro, rng) -> (new_stats,
+    grads, loss)`` where ``xs`` is the scanned micro-batch stack (any
+    pytree with leading axis A), ``get_micro(aug_rng, micro_xs) ->
+    (images, labels)`` materialises one micro-batch, and ``rng`` is the
+    per-optimizer-step key (already step- and axis-folded).  ``grads`` and
+    ``loss`` are the micro-batch means; BN stats chain through the
+    micro-batches in order (each forward normalises with its own
+    micro-batch statistics, exactly like torch under accumulation).
+    """
+
+    def accum(params, stats0, xs, get_micro, rng):
+        def one_micro(carry, micro):
+            stats, gsum, lsum, k = carry
+            mrng = jax.random.fold_in(rng, k)
+            images, labels = get_micro(jax.random.fold_in(mrng, 1), micro)
+            loss, stats, grads = loss_and_grads(params, stats, images,
+                                                labels, mrng)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
+            return (stats, gsum, lsum + loss, k + 1), None
+
+        a = jax.tree_util.tree_leaves(xs)[0].shape[0]
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (new_stats, gsum, lsum, _), _ = lax.scan(
+            one_micro, (stats0, zeros, jnp.zeros(()),
+                        jnp.zeros((), jnp.int32)), xs)
+        grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
+        return new_stats, grads, lsum / a
+
+    return accum
+
+
+def make_group_update(sgd_config: sgd_lib.SGDConfig,
+                      lr_schedule: Callable[[jax.Array], jax.Array]):
+    """The replicated SGD update stage: ``update(state, grads, new_stats)
+    -> state`` at ``lr_schedule(state.step)`` — signature-compatible with
+    the zero path's sharded update (train/zero.py:_make_zero_update), so
+    :func:`make_group_step` composes with either."""
+
+    def update(state: TrainState, grads, new_stats) -> TrainState:
+        lr_t = lr_schedule(state.step)
+        params, opt_state = sgd_lib.apply_updates(
+            state.params, grads, state.opt_state, lr_t, sgd_config)
+        return TrainState(params, new_stats, opt_state, state.step + 1)
+
+    return update
+
+
+def make_group_step(group_grads, update):
+    """ONE shared per-optimizer-step body for every execution strategy
+    (streaming / resident x plain / accumulation x replicated / sharded
+    update): fold the per-step RNG (by step counter, then by shard index —
+    the fold structure every trajectory-equality test depends on), compute
+    the group's gradients, apply the update.
+
+    ``group_grads(params, stats, xs, rng) -> (new_stats, grads, loss)``
+    computes the optimizer step's gradient from ``xs`` (a batch dict, a
+    micro-batch stack, or an index row/group — it closes over its own
+    materialisation); ``update(state, grads, new_stats) -> state`` is
+    :func:`make_group_update` or the zero path's sharded update.  Returns
+    ``step(state, xs, rng) -> (state, loss)``.
+    """
+
+    def group_step(state: TrainState, xs, rng):
+        rng = jax.random.fold_in(rng, state.step)
+        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
+        new_stats, grads, loss = group_grads(state.params, state.batch_stats,
+                                             xs, rng)
+        return update(state, grads, new_stats), loss
+
+    return group_step
+
+
 def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
                     mesh: Mesh, compute_dtype=None,
@@ -157,18 +247,12 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
     loader must be built with ``augment=False``.  ``sync_bn=True`` syncs
     BN statistics across shards (multigpu.py:127's commented-out option).
     """
-    core = make_batch_core(model, sgd_config, lr_schedule,
-                           compute_dtype=compute_dtype, sync_bn=sync_bn)
-
-    def _shard_body(state: TrainState, batch, rng):
-        def get_batch(aug_rng):
-            images = batch["image"]
-            if device_augment:
-                from ..data.device_augment import random_crop_flip
-                images = random_crop_flip(aug_rng, images)
-            return images, batch["label"]
-
-        return core(state, get_batch, rng)
+    _shard_body = make_group_step(
+        make_single_micro(
+            make_loss_and_grads(model, compute_dtype=compute_dtype,
+                                sync_bn=sync_bn),
+            _micro_from_batch(device_augment)),
+        make_group_update(sgd_config, lr_schedule))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
@@ -198,38 +282,12 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
     follows.  Distinct A values (a ragged tail group) compile once each.
     ``loss`` is the mean of the micro-batch global-mean losses.
     """
-    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
-                                         sync_bn=sync_bn)
-
-    def _shard_body(state: TrainState, batch, rng):
-        rng = jax.random.fold_in(rng, state.step)
-        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-
-        def one_micro(carry, micro):
-            stats, gsum, lsum, k = carry
-            mrng = jax.random.fold_in(rng, k)
-            images = micro["image"]
-            if device_augment:
-                from ..data.device_augment import random_crop_flip
-                images = random_crop_flip(jax.random.fold_in(mrng, 1),
-                                          images)
-            loss, stats, grads = loss_and_grads(
-                state.params, stats, images, micro["label"], mrng)
-            gsum = jax.tree_util.tree_map(jnp.add, gsum, grads)
-            return (stats, gsum, lsum + loss, k + 1), None
-
-        a = batch["label"].shape[0]
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-        (new_stats, gsum, lsum, _), _ = lax.scan(
-            one_micro, (state.batch_stats, zeros, jnp.zeros(()),
-                        jnp.zeros((), jnp.int32)), batch)
-        grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
-        loss = lsum / a
-        lr_t = lr_schedule(state.step)
-        params, opt_state = sgd_lib.apply_updates(
-            state.params, grads, state.opt_state, lr_t, sgd_config)
-        return (TrainState(params, new_stats, opt_state, state.step + 1),
-                loss)
+    accum = make_accum_scan(make_loss_and_grads(
+        model, compute_dtype=compute_dtype, sync_bn=sync_bn))
+    get_micro = _micro_from_batch(device_augment)
+    _shard_body = make_group_step(
+        lambda p, s, xs, rng: accum(p, s, xs, get_micro, rng),
+        make_group_update(sgd_config, lr_schedule))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,

@@ -97,23 +97,10 @@ class Trainer:
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
-        if self.grad_accum > 1 and (resident or shard_update):
-            raise ValueError(
-                "grad_accum > 1 is supported on the streaming replicated "
-                "path only (not with resident or shard_update)")
-        if sync_bn and shard_update:
-            # zero.py runs under check_vma=False, where the legacy psum
-            # transpose rule (psum -> psum) would silently scale the BN
-            # statistics' cotangents by the mesh size.
-            raise ValueError("sync_bn is not supported with shard_update")
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
             # lives as one flat array sharded over ``data`` (1/R per chip).
             # Checkpoints stay in the canonical per-leaf format either way.
-            if resident:
-                raise ValueError(
-                    "shard_update is not yet supported with the resident "
-                    "scan-per-epoch path; use the streaming path")
             from .zero import init_opt_shard, pytree_to_opt_shard
             opt = (pytree_to_opt_shard(self.state.opt_state.momentum_buf,
                                        mesh)
@@ -121,6 +108,8 @@ class Trainer:
             self.state = TrainState(self.state.params, self.state.batch_stats,
                                     opt, self.state.step)
         self.resident = None
+        kw = dict(compute_dtype=compute_dtype, device_augment=device_augment,
+                  sync_bn=sync_bn)
         if resident:
             # Device-resident path: dataset uploaded once, whole epoch as a
             # single jitted lax.scan (train/epoch.py) — zero per-step host
@@ -132,28 +121,27 @@ class Trainer:
                     "skipped; build the TrainLoader with augment=False and "
                     "pass device_augment=True instead")
             from ..data.resident import ResidentData
-            from .epoch import make_train_epoch
+            from .epoch import make_train_epoch, make_train_epoch_accum
+            from .zero import (make_train_epoch_zero,
+                               make_train_epoch_zero_accum)
             self.resident = ResidentData(train_loader.dataset, mesh)
-            self.train_epoch = make_train_epoch(
-                model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment,
-                sync_bn=sync_bn)
-        elif shard_update:
-            from .zero import make_train_step_zero
-            self.train_step = make_train_step_zero(
-                model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment)
-        elif self.grad_accum > 1:
-            from .step import make_train_step_accum
-            self.train_step = make_train_step_accum(
-                model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment,
-                sync_bn=sync_bn)
+            build = {(False, False): make_train_epoch,
+                     (False, True): make_train_epoch_accum,
+                     (True, False): make_train_epoch_zero,
+                     (True, True): make_train_epoch_zero_accum}[
+                (shard_update, self.grad_accum > 1)]
+            self.train_epoch = build(model, sgd_config, lr_schedule, mesh,
+                                     **kw)
         else:
-            self.train_step = make_train_step(
-                model, sgd_config, lr_schedule, mesh,
-                compute_dtype=compute_dtype, device_augment=device_augment,
-                sync_bn=sync_bn)
+            from .step import make_train_step_accum
+            from .zero import make_train_step_zero, make_train_step_zero_accum
+            build = {(False, False): make_train_step,
+                     (False, True): make_train_step_accum,
+                     (True, False): make_train_step_zero,
+                     (True, True): make_train_step_zero_accum}[
+                (shard_update, self.grad_accum > 1)]
+            self.train_step = build(model, sgd_config, lr_schedule, mesh,
+                                    **kw)
 
     def _epoch_losses_streaming(self):
         """Per-step dispatch over host-fed batches (the reference's loop,
@@ -185,6 +173,28 @@ class Trainer:
         from .epoch import put_index_matrix
         full, tail = self.train_loader.epoch_index_matrix()
         parts = []
+        if self.grad_accum > 1:
+            # Group the epoch's batches into [G, A, B] optimizer-step
+            # stacks for the accumulation epoch scan — the same grouping
+            # _stack_groups produces on the streaming path (full groups of
+            # A, a remainder group, the ragged tail alone), so optimizer
+            # step counts and the LR trajectory are identical.
+            a = self.grad_accum
+            n_groups, rem = divmod(full.shape[0], a)
+            calls = []
+            if n_groups:
+                calls.append(full[:n_groups * a].reshape(n_groups, a, -1))
+            if rem:
+                calls.append(full[n_groups * a:][None])
+            if tail is not None:
+                calls.append(tail[None, None, :])
+            for idx3 in calls:
+                idx = put_index_matrix(idx3, self.mesh)
+                self.state, losses = self.train_epoch(
+                    self.state, self.resident.images, self.resident.labels,
+                    idx, self.rng)
+                parts.append(losses)
+            return jnp.concatenate(parts) if parts else None
         if full.shape[0]:
             idx = put_index_matrix(full, self.mesh)
             self.state, losses = self.train_epoch(

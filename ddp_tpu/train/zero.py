@@ -17,14 +17,29 @@ matters at scale, expressed with explicit ICI collectives over the same
 1-D ``data`` mesh.
 
 Numerically identical to the replicated path modulo collective reduction
-order (pinned by tests/test_zero.py).  BatchNorm stays per-shard — the
-forward/backward is untouched; only the update stage changes.
+order (pinned by tests/test_zero.py).  BatchNorm stays per-shard by default;
+``sync_bn=True`` psums the batch statistics exactly like the replicated
+path's opt-in (multigpu.py:127's commented-out SyncBatchNorm).
 
-Implementation note: this step uses ``shard_map(..., check_vma=False)``
+The sharded update composes with every execution strategy the replicated
+update supports — streaming per-step, gradient accumulation
+(``make_train_step_zero_accum``), and the device-resident scan-per-epoch
+paths (``make_train_epoch_zero`` / ``make_train_epoch_zero_accum``) — all
+built from the same shared cores (:func:`_make_local_grads`,
+:func:`~ddp_tpu.train.step.make_accum_scan`,
+:func:`_make_zero_update`) so they cannot drift from one another.
+
+Implementation note: these steps use ``shard_map(..., check_vma=False)``
 because the varying-axes type system has no way (in this JAX version) to
 re-mark an ``all_gather`` result as replicated; with the check off, the
 gradient psum is NOT auto-inserted, which is exactly what lets us
-reduce-*scatter* instead.  Every collective here is therefore explicit.
+reduce-*scatter* instead.  Every collective here is therefore explicit, and
+the differentiated objective is the *local* ``ce_sum/(count*R)`` whose
+shard-sum is the global-mean loss: the transpose of any ``psum`` inside the
+forward (sync-BN statistics) then contributes exactly the cross-shard
+cotangents of that summed objective, while the loss itself is deliberately
+NOT psum'd inside ``jax.grad`` (the legacy psum transpose would scale the
+cotangents by R if it were).
 """
 from __future__ import annotations
 
@@ -40,7 +55,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
 from ..parallel.mesh import DATA_AXIS, replicated_sharding
-from .step import TrainState, _as_input
+from .step import (TrainState, _as_input, _micro_from_batch,
+                   make_accum_scan, make_group_step, make_single_micro,
+                   micro_from_table)
 
 
 def padded_size(params, axis_size: int) -> int:
@@ -93,48 +110,48 @@ def pytree_to_opt_shard(momentum_pytree, mesh: Mesh) -> sgd_lib.SGDState:
     return sgd_lib.SGDState(_put_flat_sharded(flat_np, mesh))
 
 
-def make_train_step_zero(model, sgd_config: sgd_lib.SGDConfig,
-                         lr_schedule: Callable[[jax.Array], jax.Array],
-                         mesh: Mesh, compute_dtype=None,
-                         device_augment: bool = False):
-    """Like :func:`~ddp_tpu.train.step.make_train_step` but with the
-    weight update sharded over ``data``.  ``state.opt_state.momentum_buf``
-    must come from :func:`init_opt_shard` / :func:`pytree_to_opt_shard`.
+def _make_local_grads(model, R: int, compute_dtype=None,
+                      sync_bn: bool = False):
+    """Per-shard forward/backward of the collective-free LOCAL objective
+    ``ce_sum/(count*R)``: its sum over the R shards is the global-mean loss
+    (equal per-shard counts — the sampler padding guarantee,
+    multigpu.py:153), so the psum_scatter of these local grads is exactly
+    the replicated path's gradient.  Returns
+    ``fn(params, stats, images, labels, rng) -> (loss, stats, grads)`` —
+    the same signature and return order as
+    :func:`~ddp_tpu.train.step.make_loss_and_grads`, so the two cores are
+    interchangeable under :func:`~ddp_tpu.train.step.make_accum_scan`;
+    ``loss`` is the psum'd global mean and ``stats`` pmean'd.
     """
-    R = mesh.devices.size
-    mu, wd = sgd_config.momentum, sgd_config.weight_decay
 
-    def _shard_body(state: TrainState, batch, rng):
-        rng = jax.random.fold_in(rng, state.step)
-        rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
-        images = batch["image"]
-        if device_augment:
-            from ..data.device_augment import random_crop_flip
-            images = random_crop_flip(jax.random.fold_in(rng, 1), images)
-        labels = batch["label"]
-
+    def local_grads(params, batch_stats, images, labels, rng):
         def local_loss_fn(params):
-            logits, new_stats = model.apply(
-                params, state.batch_stats,
-                _as_input(images, compute_dtype), train=True,
-                rng=rng, compute_dtype=compute_dtype)
+            from ..ops.layers import bn_sync_axis
+            with bn_sync_axis(DATA_AXIS if sync_bn else None):
+                logits, new_stats = model.apply(
+                    params, batch_stats, _as_input(images, compute_dtype),
+                    train=True, rng=rng, compute_dtype=compute_dtype)
             ce_sum, count = cross_entropy_sum_count(logits, labels)
-            # Collective-free local objective: its SUM over the R shards is
-            # the global-mean loss (equal per-shard counts — the sampler
-            # padding guarantee, multigpu.py:153), so the psum_scatter of
-            # these local grads below IS the replicated path's gradient.
-            # Deliberately no psum inside the differentiated function:
-            # under check_vma=False the legacy transpose rule psum->psum
-            # would scale cotangents by R.
             return ce_sum / (count * R), (new_stats, ce_sum, count)
 
         grads, (new_stats, ce_sum, count) = jax.grad(
-            local_loss_fn, has_aux=True)(state.params)
-        loss = (lax.psum(ce_sum, DATA_AXIS)
-                / lax.psum(count, DATA_AXIS))
+            local_loss_fn, has_aux=True)(params)
+        loss = lax.psum(ce_sum, DATA_AXIS) / lax.psum(count, DATA_AXIS)
         new_stats = jax.tree_util.tree_map(
             lambda s: lax.pmean(s, DATA_AXIS), new_stats)
+        return loss, new_stats, grads
 
+    return local_grads
+
+
+def _make_zero_update(sgd_config: sgd_lib.SGDConfig,
+                      lr_schedule: Callable[[jax.Array], jax.Array], R: int):
+    """The sharded update stage: local grads -> psum_scatter -> torch-SGD on
+    the 1/R slice -> all_gather.  ``fn(state, grads, new_stats) -> state``.
+    """
+    mu, wd = sgd_config.momentum, sgd_config.weight_decay
+
+    def zero_update(state: TrainState, grads, new_stats):
         flat_g, _ = ravel_pytree(grads)
         flat_p, unravel = ravel_pytree(state.params)
         n = flat_p.shape[0]
@@ -152,19 +169,18 @@ def make_train_step_zero(model, sgd_config: sgd_lib.SGDConfig,
         new_p_shard = p_shard - lr_t * buf
         flat_new = lax.all_gather(new_p_shard, DATA_AXIS, axis=0, tiled=True)
         params = unravel(flat_new[:n])
-        return (TrainState(params, new_stats, sgd_lib.SGDState(buf),
-                           state.step + 1), loss)
+        return TrainState(params, new_stats, sgd_lib.SGDState(buf),
+                          state.step + 1)
 
-    state_specs = TrainState(params=P(), batch_stats=P(),
-                             opt_state=sgd_lib.SGDState(P(DATA_AXIS)),
-                             step=P())
-    mapped = jax.shard_map(
-        _shard_body, mesh=mesh,
-        in_specs=(state_specs,
-                  {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
-        out_specs=(state_specs, P()),
-        check_vma=False,
-    )
+    return zero_update
+
+
+def _zero_state_specs() -> TrainState:
+    return TrainState(params=P(), batch_stats=P(),
+                      opt_state=sgd_lib.SGDState(P(DATA_AXIS)), step=P())
+
+
+def _zero_jit(mapped, mesh: Mesh):
     rep = replicated_sharding(mesh)
     state_shardings = TrainState(
         params=rep, batch_stats=rep,
@@ -172,3 +188,121 @@ def make_train_step_zero(model, sgd_config: sgd_lib.SGDConfig,
         step=rep)
     return jax.jit(mapped, donate_argnums=(0,),
                    out_shardings=(state_shardings, rep))
+
+
+def make_train_step_zero(model, sgd_config: sgd_lib.SGDConfig,
+                         lr_schedule: Callable[[jax.Array], jax.Array],
+                         mesh: Mesh, compute_dtype=None,
+                         device_augment: bool = False,
+                         sync_bn: bool = False):
+    """Like :func:`~ddp_tpu.train.step.make_train_step` but with the
+    weight update sharded over ``data``.  ``state.opt_state.momentum_buf``
+    must come from :func:`init_opt_shard` / :func:`pytree_to_opt_shard`.
+    """
+    R = mesh.devices.size
+    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
+    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+    _shard_body = make_group_step(
+        make_single_micro(local_grads, _micro_from_batch(device_augment)),
+        zero_update)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(_zero_state_specs(),
+                  {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
+        out_specs=(_zero_state_specs(), P()),
+        check_vma=False,
+    )
+    return _zero_jit(mapped, mesh)
+
+
+def make_train_step_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
+                               lr_schedule: Callable[[jax.Array], jax.Array],
+                               mesh: Mesh, compute_dtype=None,
+                               device_augment: bool = False,
+                               sync_bn: bool = False):
+    """Gradient accumulation with the sharded update: ``batch`` arrays are
+    ``[A, B, ...]`` micro-batch stacks (as for
+    :func:`~ddp_tpu.train.step.make_train_step_accum`, same RNG fold
+    structure); grads are averaged over the inner scan, then ONE
+    reduce-scatter + sharded SGD + all-gather."""
+    R = mesh.devices.size
+    accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
+                                              sync_bn))
+    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+    get_micro = _micro_from_batch(device_augment)
+    _shard_body = make_group_step(
+        lambda p, s, xs, rng: accum(p, s, xs, get_micro, rng), zero_update)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(_zero_state_specs(),
+                  {"image": P(None, DATA_AXIS), "label": P(None, DATA_AXIS)},
+                  P()),
+        out_specs=(_zero_state_specs(), P()),
+        check_vma=False,
+    )
+    return _zero_jit(mapped, mesh)
+
+
+def make_train_epoch_zero(model, sgd_config: sgd_lib.SGDConfig,
+                          lr_schedule: Callable[[jax.Array], jax.Array],
+                          mesh: Mesh, compute_dtype=None,
+                          device_augment: bool = False,
+                          sync_bn: bool = False):
+    """Device-resident scan-per-epoch with the sharded update:
+    ``--resident`` composed with ``--shard_update``.  Same signature as
+    :func:`~ddp_tpu.train.epoch.make_train_epoch` (``idx``: int32
+    ``[steps, global_batch]``); the RNG fold structure matches the
+    streaming zero step, so the two agree step-for-step."""
+    R = mesh.devices.size
+    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
+    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+
+    def _shard_body(state: TrainState, images, labels, idx, rng):
+        group = make_group_step(
+            make_single_micro(local_grads,
+                          micro_from_table(images, labels, device_augment)),
+            zero_update)
+        return lax.scan(lambda st, idx_row: group(st, idx_row, rng),
+                        state, idx)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(_zero_state_specs(), P(), P(), P(None, DATA_AXIS), P()),
+        out_specs=(_zero_state_specs(), P()),
+        check_vma=False,
+    )
+    return _zero_jit(mapped, mesh)
+
+
+def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
+                                lr_schedule: Callable[[jax.Array],
+                                                      jax.Array],
+                                mesh: Mesh, compute_dtype=None,
+                                device_augment: bool = False,
+                                sync_bn: bool = False):
+    """``--resident`` + ``--grad_accum`` + ``--shard_update`` together:
+    the grouped epoch scan (``idx``: ``[G, A, global_batch]``, as for
+    :func:`~ddp_tpu.train.epoch.make_train_epoch_accum`) with one sharded
+    update per group."""
+    R = mesh.devices.size
+    accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
+                                              sync_bn))
+    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+
+    def _shard_body(state: TrainState, images, labels, idx, rng):
+        get_micro = micro_from_table(images, labels, device_augment)
+        group = make_group_step(
+            lambda p, s, xs, g: accum(p, s, xs, get_micro, g), zero_update)
+        return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
+                        state, idx)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(_zero_state_specs(), P(), P(), P(None, None, DATA_AXIS),
+                  P()),
+        out_specs=(_zero_state_specs(), P()),
+        check_vma=False,
+    )
+    return _zero_jit(mapped, mesh)

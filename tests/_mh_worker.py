@@ -3,7 +3,8 @@ devices each) driving the REAL framework path — ``jax.distributed``
 rendezvous, per-host ``TrainLoader`` slice, ``make_array_from_process_local_
 data`` batch assembly, shard_map train step, process-0 checkpoint write.
 
-Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path> [mode]
+Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path>
+       [mode] [epochs] [resume]
 
 ``mode`` is ``streaming`` (default; per-step host-fed batches),
 ``resident`` (HBM-resident dataset + scan-per-epoch: exercises
@@ -11,6 +12,10 @@ Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path> [mode]
 ``put_index_matrix``'s local-column assembly across real processes), or
 ``zero`` (weight-update sharding: exercises the cross-process momentum
 shard and the collective checkpoint canonicalisation in train/zero.py).
+``epochs`` (default 2) is the target epoch count, and a literal ``resume``
+6th argument restores from the checkpoint first — every process reads the
+rank-0 file (the all-host restore of the replicated pytree, BASELINE.json
+config #5).
 """
 import os
 import sys
@@ -47,12 +52,14 @@ def main() -> None:
                          augment=False, seed=7, local_replicas=local)
     sched = functools.partial(triangular_lr, base_lr=0.1, num_epochs=2,
                               steps_per_epoch=len(loader))
+    epochs = int(sys.argv[5]) if len(sys.argv) > 5 else 2
+    resume = len(sys.argv) > 6 and sys.argv[6] == "resume"
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
-                      save_every=1, snapshot_path=ckpt_path,
+                      save_every=1, snapshot_path=ckpt_path, resume=resume,
                       resident=(mode == "resident"),
                       shard_update=(mode == "zero"))
-    trainer.train(2)  # process 0 writes the checkpoint (rank-0 gate)
+    trainer.train(epochs)  # process 0 writes the checkpoint (rank-0 gate)
     dist.shutdown()
 
 

@@ -33,20 +33,26 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_and_compare(tmp_path, mode: str, *, rtol=1e-6, atol=1e-7) -> None:
-    ckpt = str(tmp_path / "mh.pt")
+def _spawn_workers(ckpt: str, mode: str, extra: list = ()) -> None:
     coord = f"localhost:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(pid), coord, ckpt, mode],
+        [sys.executable, _WORKER, str(pid), coord, ckpt, mode, *extra],
         cwd=_REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT) for pid in (0, 1)]
     outs = [p.communicate(timeout=600)[0].decode() for p in procs]
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     assert os.path.exists(ckpt)
+
+
+def _run_and_compare(tmp_path, mode: str, *, rtol=1e-6, atol=1e-7,
+                     spawns=(("2",),)) -> None:
+    ckpt = str(tmp_path / "mh.pt")
+    for extra in spawns:
+        _spawn_workers(ckpt, mode, list(extra))
 
     # Ground truth: same run, one process, 8 local devices (conftest mesh).
     mesh = make_mesh(8)
@@ -94,6 +100,17 @@ def test_two_process_resident_matches_single_process(tmp_path):
     out any indexing/assembly error — a wrong column mapping would show up
     as O(1) differences)."""
     _run_and_compare(tmp_path, "resident", rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_two_process_resume_mid_run(tmp_path):
+    """Mid-run checkpoint save/restore on multi-host (BASELINE.json config
+    #5): both processes train one epoch (rank 0 writes the checkpoint), a
+    SECOND rendezvous restores it on every process and trains the final
+    epoch — the interrupted trajectory must equal the uninterrupted
+    single-process one."""
+    _run_and_compare(tmp_path, "streaming",
+                     spawns=(("1",), ("2", "resume")))
 
 
 @pytest.mark.slow

@@ -1,7 +1,7 @@
 """Device-resident scan-per-epoch path vs the streaming per-step path.
 
 The two execution strategies share the per-batch math
-(train/step.py:make_batch_core), so on identical weights and data order
+(train/step.py: make_loss_and_grads under make_group_step), so on identical weights and data order
 they must agree — the same golden-reference discipline the reference's two
 scripts embody (singlegpu.py as the numerics fixture for multigpu.py,
 SURVEY.md §4).
@@ -28,7 +28,8 @@ from ddp_tpu.train.evaluate import evaluate_resident
 
 
 def _train(resident, *, n_train, batch, replicas, epochs=1,
-           device_augment=False, model_name="vgg", seed=3, lr=0.02):
+           device_augment=False, model_name="vgg", seed=3, lr=0.02,
+           grad_accum=1):
     train_ds, _ = synthetic(n_train=n_train, n_test=16)
     mesh = make_mesh(replicas)
     model = get_model(model_name)
@@ -40,7 +41,8 @@ def _train(resident, *, n_train, batch, replicas, epochs=1,
     tr = Trainer(model, loader, params, stats, mesh=mesh, lr_schedule=sched,
                  sgd_config=SGDConfig(lr=lr), save_every=10**9,
                  snapshot_path=None, seed=seed,
-                 device_augment=device_augment, resident=resident)
+                 device_augment=device_augment, resident=resident,
+                 grad_accum=grad_accum)
     tr.train(epochs)
     return tr
 
@@ -94,6 +96,31 @@ def test_resident_single_replica_ragged():
     kw = dict(n_train=40, batch=16, replicas=1, model_name="deepnn")
     a, b = _train(False, **kw), _train(True, **kw)
     assert len(a.loss_history) == 3  # 2 full + tail of 8
+    _assert_same_training(a, b)
+
+
+def test_resident_grad_accum_matches_streaming():
+    """--resident composed with --grad_accum: the grouped epoch scan must
+    reproduce the streaming accumulation path — full groups of A, the
+    remainder group, and the ragged tail as its own optimizer step.
+
+    88 samples / 2 replicas = 44/shard, batch 8 -> 5 full batches + tail
+    of 4; A=2 -> groups [2],[2],[1 remainder],[tail] = 4 optimizer steps.
+    """
+    kw = dict(n_train=88, batch=8, replicas=2, model_name="deepnn",
+              grad_accum=2)
+    a, b = _train(False, **kw), _train(True, **kw)
+    assert len(a.loss_history) == 4
+    _assert_same_training(a, b)
+
+
+def test_resident_grad_accum_device_augment():
+    """The composed path folds the same per-micro augmentation RNG as the
+    streaming accumulation step."""
+    kw = dict(n_train=64, batch=8, replicas=2, model_name="deepnn",
+              grad_accum=2, device_augment=True)
+    a, b = _train(False, **kw), _train(True, **kw)
+    assert len(a.loss_history) == 2
     _assert_same_training(a, b)
 
 

@@ -83,7 +83,7 @@ def test_unsynced_bn_differs_across_sharding():
 
 def test_sync_bn_resident_matches_streaming():
     """sync_bn composes with the resident scan-per-epoch path: same core
-    (make_batch_core) => same trajectory as streaming sync-BN."""
+    (make_group_step) => same trajectory as streaming sync-BN."""
     import functools
 
     from ddp_tpu.data import TrainLoader, synthetic
