@@ -77,6 +77,34 @@ def test_graft_entry_hooks():
     ge.dryrun_multichip(8)
 
 
+def test_composed_strategy_flags_cli(tmp_path, capsys, monkeypatch):
+    """--resident --grad_accum --shard_update --sync_bn together through the
+    real CLI (the fully-composed execution strategy), including resume:
+    the second invocation restores the sharded-momentum trajectory from
+    the canonical-format checkpoint."""
+    monkeypatch.chdir(tmp_path)
+    argv = ["1", "1", "--batch_size", "8", "--synthetic", "--model",
+            "deepnn", "--lr", "0.05", "--num_devices", "2",
+            "--synthetic_size", "80", "--resident", "--grad_accum", "2",
+            "--shard_update", "--sync_bn", "--metrics_path", "m.jsonl"]
+    acc = cli.run(cli.build_parser("t").parse_args(argv), num_devices=None)
+    out = capsys.readouterr().out
+    assert "fp32 model has accuracy=" in out
+    assert (tmp_path / "checkpoint.pt").exists()
+    assert 0.0 <= acc <= 100.0
+    # 80 samples / 2 replicas / batch 8 = 5 batches -> A=2 gives 3
+    # optimizer steps (2 full groups + remainder) per epoch.
+    steps = [l for l in open("m.jsonl") if '"loss"' in l]
+    assert len(steps) == 3
+
+    args2 = cli.build_parser("t").parse_args(["2", "1"] + argv[2:] +
+                                             ["--resume"])
+    acc2 = cli.run(args2, num_devices=None)
+    out2 = capsys.readouterr().out
+    assert "Resuming training from snapshot at Epoch 0" in out2
+    assert 0.0 <= acc2 <= 100.0
+
+
 def test_eval_every(tmp_path, capsys, monkeypatch):
     """--eval_every E: periodic validation line + JSONL record per E epochs
     (the reference evaluates exactly once, after training)."""
