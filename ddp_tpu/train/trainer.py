@@ -22,13 +22,15 @@ TPU analogue of ``pin_memory=True`` + worker prefetch (singlegpu.py:177).
 from __future__ import annotations
 
 import os
+import sys
+import threading
 from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..optim.sgd import SGDConfig
+from ..optim.sgd import SGDConfig, SGDState
 from ..parallel import dist
 from ..utils.metrics import MetricsLogger
 from .checkpoint import load_checkpoint, save_checkpoint
@@ -84,6 +86,8 @@ class Trainer:
         self.metrics = metrics if self.gpu_id == 0 else None
         self.rng = jax.random.key(seed)
         self.loss_history: List[float] = []
+        self._save_thread = None
+        self._save_error: Optional[BaseException] = None
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
         if resume and snapshot_path and os.path.exists(snapshot_path):
@@ -233,6 +237,16 @@ class Trainer:
                 self.metrics.log_step(step=start_step + i, epoch=epoch,
                                       loss=loss, lr=float(lr))
 
+    def _join_pending_save(self) -> None:
+        """Wait for the in-flight async checkpoint write, re-raising any
+        error it hit (a silently-lost checkpoint must not look saved)."""
+        if self._save_thread is not None:
+            self._save_thread.join()
+            self._save_thread = None
+            if self._save_error is not None:
+                err, self._save_error = self._save_error, None
+                raise err
+
     def _save_checkpoint(self, epoch: int) -> None:
         # Canonical per-leaf momentum in the file regardless of the
         # in-memory layout: snapshots interchange across modes.  The
@@ -245,23 +259,72 @@ class Trainer:
                                             self.mesh)
         if self.gpu_id != 0:  # reference rank-0 gate, multigpu.py:118
             return
-        save_checkpoint(self.snapshot_path, self.state.params,
-                        self.state.batch_stats, opt_state,
-                        int(self.state.step), epoch)
-        # Reference print, singlegpu.py:122.
-        print(f"Epoch {epoch} | Training checkpoint saved at "
-              f"{self.snapshot_path}")
+        # Async write: snapshot the state into FRESH device buffers (an
+        # on-device copy — donation-safe: the next epoch's step donates and
+        # overwrites the original state arrays), start the device->host
+        # copies, and hand the file write to a background thread so the
+        # 75 MB transfer + npz write overlaps the next epoch's compute
+        # instead of stalling the epoch loop (the reference's torch.save
+        # blocks the loop the same way, multigpu.py:110-112).  Ordering:
+        # _join_pending_save above guarantees at most one writer and that
+        # overwrites of the fixed path happen in epoch order.
+        self._join_pending_save()
+        snap_params, snap_stats = jax.tree_util.tree_map(
+            jnp.copy, (self.state.params, self.state.batch_stats))
+        # Zero mode: opt_shard_to_pytree's output is already fresh device
+        # arrays (all-gathered, never part of the donated train state) —
+        # copying them again would round-trip ~25 MB for nothing.
+        snap_opt = (opt_state.momentum_buf if self.shard_update
+                    else jax.tree_util.tree_map(jnp.copy,
+                                                opt_state.momentum_buf))
+        for leaf in jax.tree_util.tree_leaves(
+                (snap_params, snap_stats, snap_opt)):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        step = int(self.state.step)
+
+        def write():
+            try:
+                save_checkpoint(self.snapshot_path, snap_params, snap_stats,
+                                SGDState(snap_opt), step, epoch)
+                # Reference print, singlegpu.py:122.
+                print(f"Epoch {epoch} | Training checkpoint saved at "
+                      f"{self.snapshot_path}")
+            except BaseException as e:  # surfaced at the next join
+                self._save_error = e
+
+        self._save_thread = threading.Thread(target=write, daemon=True)
+        self._save_thread.start()
 
     def train(self, max_epochs: int, epoch_callback=None) -> None:
         """Reference ``Trainer.train`` (multigpu.py:115-119): epoch loop with
         the rank-0 ``save_every`` checkpoint gate.  ``epoch_callback(epoch)``
         runs after each epoch's checkpoint gate (used for --eval_every;
         no reference analogue)."""
-        for epoch in range(self.start_epoch, max_epochs):
-            self._run_epoch(epoch)
-            # NB: like the reference, epoch 0 satisfies the modulo gate —
-            # snapshot_path=None disables checkpointing entirely.
-            if self.snapshot_path and epoch % self.save_every == 0:
-                self._save_checkpoint(epoch)
-            if epoch_callback is not None:
-                epoch_callback(epoch)
+        try:
+            for epoch in range(self.start_epoch, max_epochs):
+                self._run_epoch(epoch)
+                # NB: like the reference, epoch 0 satisfies the modulo gate
+                # — snapshot_path=None disables checkpointing entirely.
+                if self.snapshot_path and epoch % self.save_every == 0:
+                    self._save_checkpoint(epoch)
+                if epoch_callback is not None:
+                    epoch_callback(epoch)
+        finally:
+            # The last checkpoint write must be on disk before train()
+            # returns (resume and the reference's artifact contract depend
+            # on it) — on the success path AND when the loop unwinds via an
+            # exception/KeyboardInterrupt, or the daemon writer would be
+            # killed at interpreter exit and the newest checkpoint lost.
+            if sys.exc_info()[1] is None:
+                self._join_pending_save()
+            else:
+                # Already unwinding: still wait for the writer, but don't
+                # let a stale save error REPLACE the in-flight exception
+                # (e.g. a KeyboardInterrupt a caller handles for graceful
+                # shutdown) — report it instead.
+                try:
+                    self._join_pending_save()
+                except BaseException as e:
+                    print(f"checkpoint write failed during shutdown: {e!r}",
+                          file=sys.stderr)

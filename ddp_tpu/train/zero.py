@@ -89,16 +89,18 @@ def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh):
     format stays identical across modes, so snapshots are interchangeable).
 
     COLLECTIVE under multi-host: the buffer spans other processes' chips,
-    so it is resharded to replicated (an all-gather over ICI/DCN) before
-    the host read — EVERY process must call this, even though only rank 0
-    writes the file (Trainer.train orders it so).
+    so it is resharded to replicated (an all-gather over ICI/DCN) — EVERY
+    process must call this, even though only rank 0 writes the file
+    (Trainer.train orders it so).  Everything stays ON DEVICE (fresh
+    replicated arrays, async-dispatched): the caller can hand the result
+    to the async checkpoint writer without this function having blocked
+    the training loop on a device->host read.
     """
     flat, unravel = ravel_pytree(params)
     rep = jax.jit(lambda x: x,
                   out_shardings=replicated_sharding(mesh))(
         opt_state.momentum_buf)
-    buf = np.asarray(jax.device_get(rep))[:flat.shape[0]]
-    return sgd_lib.SGDState(unravel(jnp.asarray(buf)))
+    return sgd_lib.SGDState(unravel(rep[:flat.shape[0]]))
 
 
 def pytree_to_opt_shard(momentum_pytree, mesh: Mesh) -> sgd_lib.SGDState:
