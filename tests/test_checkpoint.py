@@ -96,3 +96,29 @@ def test_resume_continues_exactly(tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
                                       err_msg=str(pa))
     assert int(t_full.state.step) == int(t_res.state.step)
+
+
+def test_async_save_error_surfaces(tmp_path):
+    """A failed background checkpoint write (here: the directory vanishes)
+    must raise out of train(), not be silently swallowed by the writer
+    thread — a run that reports checkpoints it never wrote is worse than a
+    crash."""
+    bad = str(tmp_path / "no_such_dir" / "ck.pt")
+    tr = _make_trainer(bad, epochs=1)
+    with pytest.raises(OSError):
+        tr.train(1)
+
+
+def test_async_save_error_does_not_mask_inflight(tmp_path, capsys):
+    """If the epoch loop is ALREADY unwinding (user abort, say), a stale
+    async-save error must not replace the in-flight exception — it is
+    reported on stderr instead (train/trainer.py's finally clause)."""
+    bad = str(tmp_path / "no_such_dir" / "ck.pt")
+    tr = _make_trainer(bad, epochs=1)
+
+    def abort(epoch):
+        raise RuntimeError("user abort")
+
+    with pytest.raises(RuntimeError, match="user abort"):
+        tr.train(1, epoch_callback=abort)
+    assert "checkpoint write failed during shutdown" in capsys.readouterr().err
