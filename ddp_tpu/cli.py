@@ -15,10 +15,21 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
+import re
+import sys
 import time
 from typing import Optional
 
 import jax
+
+# Honor an explicit platform pin before any backend init — without it a
+# --spawn child told to run on the CPU backend would silently grab the TPU
+# (plugin platforms override JAX_PLATFORMS; see utils/platform.py).
+from .utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -61,6 +72,12 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size override (default: entry-point specific)")
+    p.add_argument("--spawn", default=0, type=int, metavar="N",
+                   help="Fork N local processes wired by a fresh rendezvous "
+                        "and run this exact command in each (the reference's "
+                        "mp.spawn fan-out, multigpu.py:262-263); device "
+                        "visibility per process is the caller's (env) "
+                        "concern")
     p.add_argument("--metrics_path", default=None,
                    help="Append per-step {step, epoch, loss, lr, wall_s} "
                         "JSON lines here (the loss stream the reference "
@@ -100,7 +117,8 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--export_torch", default=None, metavar="PATH",
                    help="After training, also write the model in the "
                         "reference's torch state_dict checkpoint format "
-                        "(flat backbone.conv0.weight keys; VGG only)")
+                        "(reference keys for vgg/deepnn, torchvision keys "
+                        "for resnet18)")
     p.add_argument("--schedule_epochs", default=None, type=int,
                    help="Pin the LR triangle's epoch span (the reference "
                         "hardcodes 20, multigpu.py:136; default: "
@@ -110,6 +128,53 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "reference hardcodes 98/49, multigpu.py:137; "
                         "default: derived from the real shard size)")
     return p
+
+
+def spawn_local(num_processes: int) -> int:
+    """The reference's local fan-out UX (``mp.spawn(main, nprocs=world_size)``,
+    multigpu.py:262-263): fork ``num_processes`` copies of the *current*
+    command — minus ``--spawn`` — each wired to a fresh localhost
+    rendezvous via the DDP_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID env
+    surface (parallel/dist.py).  Children inherit stdout/stderr, so the
+    per-rank prints interleave exactly as the reference's do.  Returns the
+    max child exit code."""
+    import socket
+    import subprocess
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # Strip --spawn in every argparse-accepted spelling, including
+    # unambiguous abbreviations (--spa/--spaw; allow_abbrev is on) — a
+    # surviving spelling would make every child re-spawn recursively.
+    spawn_re = re.compile(r"--spa(w|wn)?(=.*)?$")
+    argv, skip = [], False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+            continue
+        if spawn_re.fullmatch(a):
+            skip = "=" not in a  # bare flag consumes the following N
+            continue
+        argv.append(a)
+    procs = []
+    for pid in range(num_processes):
+        env = dict(os.environ,
+                   DDP_TPU_COORDINATOR=f"localhost:{port}",
+                   DDP_TPU_NUM_PROCESSES=str(num_processes),
+                   DDP_TPU_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, sys.argv[0], *argv], env=env))
+    return max(p.wait() for p in procs)
+
+
+def main(args: argparse.Namespace, *, num_devices: Optional[int]) -> None:
+    """Entry-point body shared by singlegpu.py/multigpu.py: fan out if
+    ``--spawn N`` was asked for, otherwise train in-process.  A process
+    that is already a spawned child (rendezvous env set) never re-spawns —
+    the backstop against any recursion."""
+    if args.spawn and "DDP_TPU_PROCESS_ID" not in os.environ:
+        raise SystemExit(spawn_local(args.spawn))
+    run(args, num_devices=num_devices)
 
 
 def _load_torch_init(model_name: str, path: str):
@@ -149,18 +214,20 @@ def _export_torch(model_name: str, path: str, trainer) -> None:
     """Write the trained model as a reference-format torch state_dict
     (the exact artifact ``torch.save(model.module.state_dict())`` produces,
     multigpu.py:110-112) so reference tooling can consume it."""
-    if model_name != "vgg":
-        raise SystemExit("--export_torch currently supports the flagship "
-                         "vgg only")
     try:
         import torch
     except ImportError as e:  # pragma: no cover
         raise SystemExit(f"--export_torch needs torch to write the pickle: "
                          f"{e}")
     from .utils import torch_interop
-    sd = torch_interop.vgg_to_torch_state_dict(
-        jax.device_get(trainer.state.params),
-        jax.device_get(trainer.state.batch_stats))
+    params = jax.device_get(trainer.state.params)
+    stats = jax.device_get(trainer.state.batch_stats)
+    if model_name == "vgg":
+        sd = torch_interop.vgg_to_torch_state_dict(params, stats)
+    elif model_name == "deepnn":
+        sd = torch_interop.deepnn_to_torch_state_dict(params)
+    else:
+        sd = torch_interop.resnet18_to_torch_state_dict(params, stats)
     out = {k: torch.from_numpy(np.array(v))  # copy: writable + contiguous
            for k, v in sd.items()}
     # strict load_state_dict compatibility: torch BN carries a
@@ -255,24 +322,32 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
                              local_replicas=local_replicas)
 
+    resident_test_cache: list = []  # test set uploaded to HBM at most once
+
     def _eval(progress: bool) -> float:
         if args.resident:
             from .data.resident import ResidentData
             from .train.evaluate import evaluate_resident
+            if not resident_test_cache:
+                resident_test_cache.append(ResidentData(test_ds, mesh))
             return evaluate_resident(
                 model, trainer.state.params, trainer.state.batch_stats,
-                ResidentData(test_ds, mesh), eval_loader, mesh)
+                resident_test_cache[0], eval_loader, mesh)
         return evaluate(model, trainer.state.params,
                         trainer.state.batch_stats, eval_loader, mesh,
                         progress=progress)
 
     def _epoch_callback(epoch: int) -> None:
         # --eval_every: periodic validation (no reference analogue — it
-        # evaluates once, after training, multigpu.py:247).
+        # evaluates once, after training, multigpu.py:247).  The eval is a
+        # collective (sharded psum counters) so every process runs it; the
+        # print/metrics record is rank-0-gated like the Trainer's per-step
+        # stream, keeping the two metric streams consistent on multi-host.
         if args.eval_every and (epoch + 1) % args.eval_every == 0:
             acc = _eval(progress=False)
-            print(f"Epoch {epoch} | eval accuracy={acc:.2f}%")
-            metrics.log_eval(epoch=epoch, accuracy=acc)
+            if jax.process_index() == 0:
+                print(f"Epoch {epoch} | eval accuracy={acc:.2f}%")
+                metrics.log_eval(epoch=epoch, accuracy=acc)
 
     start = time.time()
     if args.profile_dir:

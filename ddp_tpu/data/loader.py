@@ -76,19 +76,26 @@ class TrainLoader:
         return self._shards
 
     def materialize(self, k: int) -> Dict[str, np.ndarray]:
-        """Build global batch ``k`` of the current epoch.  Thread-safe and
-        order-independent: the augmentation RNG is keyed (seed, epoch, k),
-        so a prefetch pool can build batches concurrently and still be
-        deterministic.  (The reference's torchvision transforms draw from
-        one global torch RNG stream — per-batch keying preserves the
+        """Build global batch ``k`` of the current epoch.  Thread-safe,
+        order-independent AND topology-invariant: the augmentation RNG is
+        keyed (seed, epoch, k, GLOBAL replica id), so a prefetch pool can
+        build batches concurrently, and a replica's rows get the same
+        crops/flips no matter which process materialises them — a 2-host
+        run augments identically to the single-process run of the same
+        seed.  (The reference's torchvision transforms draw from one
+        global torch RNG stream — per-replica keying preserves the
         distribution, which is what the loss curve depends on.)"""
         shards = self._epoch_shards()
         b = self.per_replica_batch
         idx = np.concatenate([sh[k * b:(k + 1) * b] for sh in shards])
         imgs = self.dataset.images[idx]
         if self.augment:
-            rng = np.random.default_rng((self.seed, self.epoch, k, 0x5EED))
-            imgs = random_crop_flip(imgs, rng)
+            per_rep = [random_crop_flip(
+                part, np.random.default_rng(
+                    (self.seed, self.epoch, k, int(r), 0x5EED)))
+                for r, part in zip(self.local_replicas,
+                                   np.split(imgs, len(self.samplers)))]
+            imgs = np.concatenate(per_rep)
         # uint8 on the wire; ToTensor scaling happens on device
         # (train.step._as_input) at 1/4 the transfer bytes.
         return {"image": imgs, "label": self.dataset.labels[idx]}

@@ -20,6 +20,7 @@ self-configure, and silently stays single-host otherwise.
 from __future__ import annotations
 
 import os
+import sys
 from typing import Optional
 
 import jax
@@ -48,10 +49,20 @@ def initialize(coordinator: Optional[str] = None,
             try:
                 jax.distributed.initialize()
                 _initialized = True
-            except RuntimeError:
+            except RuntimeError as e:
                 # Backend already initialised (e.g. a host that probed
-                # devices first) — proceed single-host rather than abort.
-                pass
+                # devices first) — proceed single-host rather than abort,
+                # but LOUDLY: in a genuinely multi-worker pod, N hosts
+                # degrading to single-host means N independent models
+                # training in silence.
+                print(
+                    "WARNING: multi-worker TPU pod detected but "
+                    f"jax.distributed.initialize() failed ({e!r}); "
+                    "proceeding SINGLE-HOST. If this is a real pod, every "
+                    "worker is now training an independent model — fix the "
+                    "rendezvous (or set DDP_TPU_COORDINATOR/"
+                    "DDP_TPU_NUM_PROCESSES/DDP_TPU_PROCESS_ID) and restart.",
+                    file=sys.stderr)
         return  # plain single-host: nothing to rendezvous
     jax.distributed.initialize(
         coordinator_address=coordinator,

@@ -239,12 +239,34 @@ class Trainer:
 
     def _join_pending_save(self) -> None:
         """Wait for the in-flight async checkpoint write, re-raising any
-        error it hit (a silently-lost checkpoint must not look saved)."""
+        error it hit (a silently-lost checkpoint must not look saved).
+
+        Multi-host: only rank 0 writes, so only rank 0 raises — left alone,
+        ranks 1+ would block forever in the next epoch's collectives.  Tear
+        down the coordination service first so the peers' heartbeats fail
+        fast (a clean distributed abort, not a hang)."""
         if self._save_thread is not None:
             self._save_thread.join()
             self._save_thread = None
             if self._save_error is not None:
                 err, self._save_error = self._save_error, None
+                if jax.process_count() > 1:
+                    print(f"[GPU{self.gpu_id}] FATAL: async checkpoint "
+                          f"write failed: {err!r}; shutting down the "
+                          "coordinator so peer processes abort instead of "
+                          "hanging in the next collective",
+                          file=sys.stderr)
+                    sys.stderr.flush()
+                    # Tear down unconditionally — dist.shutdown() is gated
+                    # on dist.initialize() having done the init, but the
+                    # runtime may have been initialised by the launcher /
+                    # jax.distributed directly, and a no-op here recreates
+                    # the exact peer hang this path exists to prevent.
+                    try:
+                        dist.shutdown()
+                        jax.distributed.shutdown()
+                    except (RuntimeError, ValueError):
+                        pass  # already torn down (e.g. by dist.shutdown())
                 raise err
 
     def _save_checkpoint(self, epoch: int) -> None:

@@ -97,10 +97,15 @@ def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh):
     the training loop on a device->host read.
     """
     flat, unravel = ravel_pytree(params)
-    rep = jax.jit(lambda x: x,
-                  out_shardings=replicated_sharding(mesh))(
+    n = flat.shape[0]
+    # The truncating slice AND the unravel reshapes run INSIDE the jit:
+    # eager ops on arrays spanning other processes' devices are
+    # version-sensitive under multi-host, while jitted computation on them
+    # is the supported path (all device computation stays inside jit).
+    tree = jax.jit(lambda x: unravel(x[:n]),
+                   out_shardings=replicated_sharding(mesh))(
         opt_state.momentum_buf)
-    return sgd_lib.SGDState(unravel(rep[:flat.shape[0]]))
+    return sgd_lib.SGDState(tree)
 
 
 def pytree_to_opt_shard(momentum_pytree, mesh: Mesh) -> sgd_lib.SGDState:

@@ -1,0 +1,43 @@
+"""Backend-platform pinning helpers.
+
+Device-plugin platforms (e.g. the experimental axon TPU tunnel) override
+the standard ``JAX_PLATFORMS`` env var, so any process that must run on a
+specific backend needs a ``jax.config`` pin *before* backend init, and any
+parent spawning such a process needs a consistent child environment.  This
+is the single home for that workaround — bench.py, cli.py (--spawn) and
+__graft_entry__.py (dryrun bootstrap) all share it.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional
+
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+")
+
+
+def pin_platform_from_env() -> None:
+    """Apply a DDP_TPU_PLATFORM pin through jax.config (no-op if unset).
+    Must run before any JAX backend initialisation."""
+    platform = os.environ.get("DDP_TPU_PLATFORM")
+    if platform:
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+
+def cpu_device_env(n_devices: int,
+                   base_env: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """Child-process environment forcing an ``n_devices``-wide virtual CPU
+    mesh: platform pinned via both JAX_PLATFORMS and DDP_TPU_PLATFORM (the
+    latter survives plugin override when the child calls
+    :func:`pin_platform_from_env` or imports ``ddp_tpu.cli``/``bench``),
+    and exactly one ``--xla_force_host_platform_device_count`` flag."""
+    env = dict(os.environ if base_env is None else base_env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DDP_TPU_PLATFORM"] = "cpu"
+    flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env

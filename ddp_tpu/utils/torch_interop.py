@@ -120,6 +120,30 @@ def deepnn_from_torch_state_dict(sd) -> Tuple[Dict, Dict]:
     return params, {}
 
 
+def deepnn_to_torch_state_dict(params: Dict) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`deepnn_from_torch_state_dict`, keyed for the
+    reference module layout (``features`` Sequential with convs at 0/2/5/7,
+    ``classifier`` with linears at 0/3 — singlegpu.py:18-44).  The first
+    linear's input axis is permuted back from our NHWC flatten to torch's
+    channel-major flatten."""
+    out: Dict[str, np.ndarray] = {}
+    feats = params["features"]
+    for i, slot in enumerate((0, 2, 5, 7)):
+        out[f"features.{slot}.weight"] = conv_kernel_to_torch(
+            feats[f"conv{i}"]["kernel"])
+        out[f"features.{slot}.bias"] = np.asarray(feats[f"conv{i}"]["bias"])
+    w0 = np.asarray(params["classifier"]["linear0"]["weight"]).T  # [512,2048]
+    out["classifier.0.weight"] = (
+        w0.reshape(512, 8, 8, 32).transpose(0, 3, 1, 2).reshape(512, 2048))
+    out["classifier.0.bias"] = np.asarray(
+        params["classifier"]["linear0"]["bias"])
+    out["classifier.3.weight"] = np.asarray(
+        params["classifier"]["linear1"]["weight"]).T
+    out["classifier.3.bias"] = np.asarray(
+        params["classifier"]["linear1"]["bias"])
+    return out
+
+
 def _bn_from_torch(sd, prefix: str) -> Tuple[Dict, Dict]:
     return ({"scale": jnp.asarray(_np(sd[f"{prefix}.weight"])),
              "bias": jnp.asarray(_np(sd[f"{prefix}.bias"]))},
@@ -157,3 +181,40 @@ def resnet18_from_torch_state_dict(sd) -> Tuple[Dict, Dict]:
     params["fc"] = {"weight": linear_weight_from_torch(sd["fc.weight"]),
                     "bias": jnp.asarray(_np(sd["fc.bias"]))}
     return params, stats
+
+
+def _bn_to_torch(out: Dict[str, np.ndarray], prefix: str,
+                 p: Dict, s: Dict) -> None:
+    out[f"{prefix}.weight"] = np.asarray(p["scale"])
+    out[f"{prefix}.bias"] = np.asarray(p["bias"])
+    out[f"{prefix}.running_mean"] = np.asarray(s["mean"])
+    out[f"{prefix}.running_var"] = np.asarray(s["var"])
+
+
+def resnet18_to_torch_state_dict(params: Dict, batch_stats: Dict
+                                 ) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`resnet18_from_torch_state_dict` — torchvision
+    ``resnet18`` naming, so the export loads strictly into the stock
+    torchvision model."""
+    out: Dict[str, np.ndarray] = {}
+    out["conv1.weight"] = conv_kernel_to_torch(params["conv1"]["kernel"])
+    _bn_to_torch(out, "bn1", params["bn1"], batch_stats["bn1"])
+    for si in range(1, 5):
+        for bi in range(2):
+            tp = f"layer{si}.{bi}"
+            blk = params[f"layer{si}.block{bi}"]
+            bst = batch_stats[f"layer{si}.block{bi}"]
+            out[f"{tp}.conv1.weight"] = conv_kernel_to_torch(
+                blk["conv1"]["kernel"])
+            _bn_to_torch(out, f"{tp}.bn1", blk["bn1"], bst["bn1"])
+            out[f"{tp}.conv2.weight"] = conv_kernel_to_torch(
+                blk["conv2"]["kernel"])
+            _bn_to_torch(out, f"{tp}.bn2", blk["bn2"], bst["bn2"])
+            if "downsample" in blk:
+                out[f"{tp}.downsample.0.weight"] = conv_kernel_to_torch(
+                    blk["downsample"]["conv"]["kernel"])
+                _bn_to_torch(out, f"{tp}.downsample.1",
+                             blk["downsample"]["bn"], bst["downsample_bn"])
+    out["fc.weight"] = np.asarray(params["fc"]["weight"]).T
+    out["fc.bias"] = np.asarray(params["fc"]["bias"])
+    return out

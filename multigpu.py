@@ -9,10 +9,12 @@ process per *host* drives all local chips through a ``jax.sharding.Mesh``;
 ``--batch_size`` stays the per-device batch, so the global batch is
 batch_size x num_devices exactly as in DDP.  Multi-host rendezvous (the
 MASTER_ADDR/PORT analogue) comes from ``jax.distributed.initialize`` via
-DDP_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID (ddp_tpu/parallel/dist.py).
+DDP_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID (ddp_tpu/parallel/dist.py);
+``--spawn N`` forks N wired local processes — the reference's ``mp.spawn``
+UX — with per-process device visibility left to the environment.
 """
-from ddp_tpu.cli import build_parser, run
+from ddp_tpu.cli import build_parser, main
 
 if __name__ == "__main__":
     args = build_parser("simple distributed training job").parse_args()
-    run(args, num_devices=None)  # all devices
+    main(args, num_devices=None)  # all devices

@@ -6,8 +6,8 @@
 On TPU the single-device path is just a mesh of one chip running the same
 jitted train step as the distributed path (SURVEY.md §7 design stance).
 """
-from ddp_tpu.cli import build_parser, run
+from ddp_tpu.cli import build_parser, main
 
 if __name__ == "__main__":
     args = build_parser("single-device distributed training job").parse_args()
-    run(args, num_devices=1)
+    main(args, num_devices=1)

@@ -16,6 +16,10 @@ shard and the collective checkpoint canonicalisation in train/zero.py).
 6th argument restores from the checkpoint first — every process reads the
 rank-0 file (the all-host restore of the replicated pytree, BASELINE.json
 config #5).
+
+``mode`` ``cli`` drives the full ``ddp_tpu.cli.run`` path instead (with
+``--eval_every`` + ``--metrics_path`` = <ckpt>.metrics.jsonl) — used to
+assert periodic-eval prints/records are rank-0-gated across real processes.
 """
 import os
 import sys
@@ -34,6 +38,20 @@ def main() -> None:
     from ddp_tpu.parallel import dist
     dist.initialize(coordinator=coordinator, num_processes=2, process_id=pid)
     assert jax.process_count() == 2 and jax.device_count() == 8
+
+    if mode == "cli":
+        # Full CLI path on 2 real processes: the periodic eval is a
+        # collective every process must run, but its print + JSONL record
+        # must come from rank 0 only (VERDICT weak #4).  dist.initialize
+        # above already rendezvoused; cli.run's own call no-ops.
+        from ddp_tpu import cli
+        args = cli.build_parser("t").parse_args(
+            ["2", "100", "--batch_size", "4", "--synthetic", "--model",
+             "deepnn", "--lr", "0.05", "--synthetic_size", "64",
+             "--eval_every", "1", "--metrics_path",
+             ckpt_path + ".metrics.jsonl", "--snapshot_path", ckpt_path])
+        cli.run(args, num_devices=None)
+        return
 
     import functools
     from ddp_tpu.data import TrainLoader, synthetic

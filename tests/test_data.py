@@ -170,3 +170,30 @@ def test_load_download_and_extract(tmp_path):
     # Absent data + failed download -> the explanatory error.
     with pytest.raises(FileNotFoundError, match="synthetic"):
         cifar10.load(str(tmp_path / "nowhere"), download=False)
+
+
+def test_augmentation_topology_invariant():
+    """A replica's rows get identical crops/flips no matter how replicas
+    are split across processes (loader.py materialize keying): a 2-process
+    4+4 split must produce byte-identical augmented batches to the
+    single-process 8-replica loader — the property the --spawn/multi-host
+    checkpoint-equality tests rely on."""
+    from ddp_tpu.data import TrainLoader, synthetic
+
+    ds, _ = synthetic(n_train=128, seed=9)
+    full = TrainLoader(ds, per_replica_batch=4, num_replicas=8, seed=3)
+    half0 = TrainLoader(ds, per_replica_batch=4, num_replicas=8, seed=3,
+                        local_replicas=range(0, 4))
+    half1 = TrainLoader(ds, per_replica_batch=4, num_replicas=8, seed=3,
+                        local_replicas=range(4, 8))
+    for epoch in (0, 1):
+        for ldr in (full, half0, half1):
+            ldr.set_epoch(epoch)
+        for k in range(len(full)):
+            want = full.materialize(k)
+            got_i = np.concatenate([half0.materialize(k)["image"],
+                                    half1.materialize(k)["image"]])
+            got_l = np.concatenate([half0.materialize(k)["label"],
+                                    half1.materialize(k)["label"]])
+            np.testing.assert_array_equal(want["image"], got_i)
+            np.testing.assert_array_equal(want["label"], got_l)

@@ -52,3 +52,24 @@ def test_graft_dryrun_multichip():
     sys.path.insert(0, _REPO)
     import __graft_entry__ as graft
     graft.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_graft_dryrun_multichip_driver_env():
+    """Round 1's most instructive miss: the suite ran dryrun under conftest's
+    8-device CPU env and passed while the driver's bare invocation (1 visible
+    device, axon plugin overriding JAX_PLATFORMS) failed.  This reproduces
+    the *driver's* environment — no forced platform, no device-count flag —
+    and asserts the dryrun self-bootstraps its own virtual mesh."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # Keep reruns fast on this 1-CPU box: share the dryrun's own cache dir.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(_REPO, ".jax_dryrun_cache"))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r); "
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"
+         % _REPO],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=1800)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-4000:])

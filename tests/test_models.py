@@ -100,6 +100,37 @@ def test_vgg_state_dict_round_trip():
     assert set(exported) == ref_keys
 
 
+def test_deepnn_state_dict_round_trip():
+    """Export matrix completeness (VERDICT #8): deepnn export loads
+    strictly into the reference module and round-trips bit-exact."""
+    from ddp_tpu.utils.torch_interop import deepnn_to_torch_state_dict
+    torch.manual_seed(4)
+    tm = TorchDeepNN()
+    params, _ = deepnn_from_torch_state_dict(tm.state_dict())
+    exported = deepnn_to_torch_state_dict(params)
+    sd = tm.state_dict()
+    assert set(exported) == set(sd)
+    for k, v in exported.items():
+        np.testing.assert_array_equal(v, sd[k].numpy(), err_msg=k)
+    tm.load_state_dict({k: torch.from_numpy(np.array(v))
+                        for k, v in exported.items()}, strict=True)
+
+
+def test_resnet18_state_dict_round_trip():
+    from ddp_tpu.utils.torch_interop import (resnet18_from_torch_state_dict,
+                                             resnet18_to_torch_state_dict)
+    from torch_ref import TorchResNet18
+    torch.manual_seed(5)
+    tm = TorchResNet18()
+    params, stats = resnet18_from_torch_state_dict(tm.state_dict())
+    exported = resnet18_to_torch_state_dict(params, stats)
+    sd = tm.state_dict()
+    ref_keys = {k for k in sd if "num_batches_tracked" not in k}
+    assert set(exported) == ref_keys
+    for k, v in exported.items():
+        np.testing.assert_array_equal(v, sd[k].numpy(), err_msg=k)
+
+
 def test_vgg_bf16_compute_close_to_fp32():
     model = get_model("vgg")
     params, stats = model.init(jax.random.PRNGKey(0))

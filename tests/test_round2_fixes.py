@@ -1,0 +1,92 @@
+"""Round-2 hardening: resident eval-set caching, pod-init failure warning,
+and the multi-host async-save abort path (VERDICT weak #3-#5, ADVICE #1)."""
+import threading
+
+import jax
+import pytest
+
+from ddp_tpu import cli
+from ddp_tpu.parallel import dist
+
+
+def test_resident_eval_test_set_uploaded_once(tmp_path, monkeypatch):
+    """--eval_every on the resident path must NOT re-upload the test set to
+    HBM every eval epoch (VERDICT weak #3): one ResidentData per dataset —
+    train set in the Trainer, test set cached across all eval calls."""
+    import ddp_tpu.data.resident as resident_mod
+
+    real = resident_mod.ResidentData
+    uploads = []
+
+    class Counting(real):
+        def __init__(self, ds, mesh):
+            uploads.append(ds)
+            super().__init__(ds, mesh)
+
+    monkeypatch.setattr(resident_mod, "ResidentData", Counting)
+    monkeypatch.chdir(tmp_path)
+    args = cli.build_parser("t").parse_args(
+        ["2", "100", "--batch_size", "8", "--synthetic", "--model", "deepnn",
+         "--lr", "0.05", "--num_devices", "2", "--synthetic_size", "32",
+         "--resident", "--eval_every", "1", "--snapshot_path", "none.pt"])
+    cli.run(args, num_devices=None)
+    # 3 evals ran (epoch 0, epoch 1, final) but only 2 uploads happened:
+    # the train set and the test set, once each.
+    assert len(uploads) == 2
+
+
+def test_pod_autoinit_failure_warns_loudly(monkeypatch, capsys):
+    """A swallowed jax.distributed.initialize() failure on a detected pod
+    must warn on stderr (VERDICT weak #5): silently degrading to
+    single-host trains N independent models."""
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(dist, "_on_multiworker_tpu_pod", lambda: True)
+
+    def boom():
+        raise RuntimeError("backend already initialised")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    dist.initialize()
+    err = capsys.readouterr().err
+    assert "WARNING" in err and "SINGLE-HOST" in err
+    assert not dist._initialized
+
+
+def _trainer_with_failed_save(err):
+    """A Trainer skeleton whose async writer just failed with ``err`` —
+    only the fields _join_pending_save touches, no compile."""
+    from ddp_tpu.train.trainer import Trainer
+    t = Trainer.__new__(Trainer)
+    t.gpu_id = 0
+    th = threading.Thread(target=lambda: None)
+    th.start()
+    th.join()
+    t._save_thread = th
+    t._save_error = err
+    return t
+
+
+def test_async_save_failure_aborts_coordinator_multihost(monkeypatch,
+                                                         capsys):
+    """ADVICE #1: on multi-host, a rank-0 async checkpoint failure must
+    tear down the coordination service (so ranks 1+ fail fast) before
+    re-raising — not leave the peers hanging in the next collective."""
+    t = _trainer_with_failed_save(OSError("disk full"))
+    shutdowns = []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(dist, "shutdown", lambda: shutdowns.append(1))
+    with pytest.raises(OSError, match="disk full"):
+        t._join_pending_save()
+    assert shutdowns == [1]
+    assert "FATAL" in capsys.readouterr().err
+
+
+def test_async_save_failure_single_host_just_raises(monkeypatch, capsys):
+    """Single-host keeps the plain behavior: raise, no coordinator calls."""
+    t = _trainer_with_failed_save(OSError("disk full"))
+    shutdowns = []
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(dist, "shutdown", lambda: shutdowns.append(1))
+    with pytest.raises(OSError, match="disk full"):
+        t._join_pending_save()
+    assert not shutdowns and "FATAL" not in capsys.readouterr().err

@@ -163,6 +163,100 @@ def test_golden_trace_full_lr_triangle():
     assert lr16 == 0.0
 
 
+def _golden_run(n_batch, base_lr, spe, steps, seed=21):
+    """Lockstep JAX-vs-torch trajectory at the given recipe; returns
+    (jax_losses, torch_losses, jax_params, torch_params)."""
+    from ddp_tpu.data import synthetic as synthetic_ds
+    torch.manual_seed(2)
+    tmodel = TorchVGG()
+    params, stats = torch_interop.vgg_from_torch_state_dict(
+        tmodel.state_dict())
+    model = get_model("vgg")
+    mesh = make_mesh(1)
+    ds, _ = synthetic_ds(n_train=max(steps, spe) * n_batch, n_test=1,
+                         seed=seed)
+    n_data = len(ds.labels) // n_batch
+    sched = functools.partial(triangular_lr, base_lr=base_lr, num_epochs=20,
+                              steps_per_epoch=spe)
+    step_fn = make_train_step(model, SGDConfig(lr=base_lr), sched, mesh)
+    state = init_train_state(params, stats)
+    opt, lr_sched = make_reference_optimizer(
+        tmodel, lr=base_lr, num_epochs=20, steps_per_epoch=spe)
+
+    jax_losses, torch_losses = [], []
+    for step in range(steps):
+        sl = slice((step % n_data) * n_batch, (step % n_data + 1) * n_batch)
+        x = ds.images[sl].astype(np.float32) / 255.0
+        y = ds.labels[sl]
+        batch = shard_batch({"image": x, "label": y}, mesh)
+        state, loss = step_fn(state, batch, jax.random.key(0))
+        jax_losses.append(float(loss))
+
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        ty = torch.from_numpy(y.astype(np.int64))
+        opt.zero_grad()
+        tloss = F.cross_entropy(tmodel(tx), ty)
+        tloss.backward()
+        opt.step()
+        lr_sched.step()
+        torch_losses.append(tloss.item())
+    want, _ = torch_interop.vgg_from_torch_state_dict(tmodel.state_dict())
+    return (np.asarray(jax_losses), np.asarray(torch_losses),
+            jax.device_get(state.params), want)
+
+
+@pytest.mark.slow
+def test_golden_trace_exact_recipe_prefix():
+    """Parity at the EXACT reference recipe config (VERDICT #9): batch 512,
+    base_lr 0.4, steps_per_epoch 98, the 20-epoch triangle
+    (singlegpu.py:135-149, multigpu.py:259) — the first 6 optimizer steps
+    of a real run, in lockstep with the torch reference.  Measured drift
+    on this seed: max |rel loss| 3.1e-5, max |param delta| 4.4e-5 —
+    asserted with ~6x headroom.  (The full 20-epoch horizon at this batch
+    is not CPU-tractable; the scaled-recipe test below carries the
+    2-epoch-horizon claim.)"""
+    jl, tl, got, want = _golden_run(n_batch=512, base_lr=0.4, spe=98,
+                                    steps=6)
+    np.testing.assert_allclose(jl, tl, rtol=2e-4, atol=2e-4)
+    for (pw, w), (pg, g) in zip(jax.tree_util.tree_leaves_with_path(want),
+                                jax.tree_util.tree_leaves_with_path(got)):
+        assert pw == pg
+        np.testing.assert_allclose(g, w, atol=3e-4, err_msg=str(pw))
+
+
+@pytest.mark.slow
+def test_golden_trace_two_epochs_scaled_recipe():
+    """Long-horizon parity (VERDICT #9): TWO full epochs (24 optimizer
+    steps) against the torch reference at the linearly-scaled recipe —
+    batch 64 with base_lr 0.4*(64/512)=0.05, same triangle shape, same
+    momentum/wd — i.e. the reference's per-sample step sizes at a
+    CPU-tractable batch.  Data is the learnable synthetic signal so the
+    trajectory converges like the real recipe's (on random labels at this
+    LR the iteration is chaotic and fp32 drift amplifies exponentially;
+    measured 6e-2 rel by step 12 — parity unmeasurable).
+
+    Tolerance schedule (measured on this seed, ~3x headroom): epoch 1
+    per-step max |rel| 4.5e-3 -> assert 1.5e-2; epoch 2 per-step drift
+    grows to 1.0e-1 by step 24 (compounding reduction-order ULP through a
+    second epoch) -> assert 3e-1 per-step plus a 10x tighter epoch-MEAN
+    check, which is what 'loss-curve parity' means once per-step
+    microstructure decorrelates.  A semantic error (wrong wd placement, LR
+    off by one, sum-vs-mean grads) shifts the curve by O(1) from the first
+    affected step and fails every band."""
+    spe = 12
+    jl, tl, got, want = _golden_run(n_batch=64, base_lr=0.05, spe=spe,
+                                    steps=2 * spe)
+    np.testing.assert_allclose(jl[:spe], tl[:spe], rtol=1.5e-2, atol=1e-3)
+    np.testing.assert_allclose(jl, tl, rtol=3e-1, atol=5e-3)
+    assert abs(jl[spe:].mean() - tl[spe:].mean()) / tl[spe:].mean() < 0.1
+    # Trajectory claim, not just loss claim: params after the 2 epochs
+    # (measured max |delta| 1.4e-2 on weights of O(1e-1) scale).
+    for (pw, w), (pg, g) in zip(jax.tree_util.tree_leaves_with_path(want),
+                                jax.tree_util.tree_leaves_with_path(got)):
+        assert pw == pg
+        np.testing.assert_allclose(g, w, atol=5e-2, err_msg=str(pw))
+
+
 def test_dp_mesh_exact_without_dropout():
     """VGG (no dropout): 8-way DP grads pmean == single-device global mean.
     BN uses per-shard statistics, so run each shard's BN stats equalised by
