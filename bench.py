@@ -65,6 +65,10 @@ def _parse_args():
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--no_bf16", action="store_true",
                    help="Skip the secondary bf16 stderr record")
+    p.add_argument("--primary_only", action="store_true",
+                   help="Skip the secondary other-dispatch-flavor record "
+                        "(sweep children use this: each extra flavor is "
+                        "another serial XLA compile)")
     p.add_argument("--steps", default=50, type=int)
     p.add_argument("--warmup", default=10, type=int)
     p.add_argument("--repeats", default=3, type=int,
@@ -82,6 +86,19 @@ def _parse_args():
                         "CPU mesh (dispatch-overhead trend, no hardware "
                         "needed); real: children use the visible devices "
                         "(the actual scaling measurement on a pod)")
+    p.add_argument("--dispatch", default="step", choices=["step", "scan"],
+                   help="step (default): one dispatch per step — JAX async "
+                        "dispatch pipelines these, and measured throughput "
+                        "is slightly HIGHER than scan (negative result in "
+                        "BASELINE.md); scan: the whole window as one "
+                        "jitted lax.scan (the resident-epoch mode's "
+                        "dispatch pattern)")
+    p.add_argument("--profile_dir", default=None,
+                   help="Capture a jax.profiler trace of one extra "
+                        "(untimed) window of the SELECTED --dispatch "
+                        "flavor (the per-op breakdown behind BASELINE.md's "
+                        "roofline analysis; analyze with "
+                        "python -m ddp_tpu.utils.profiling)")
     p.add_argument("--pipeline", action="store_true",
                    help="Time the HOST side only: loader materialisation + "
                         "augmentation, no device in the loop — isolates "
@@ -111,18 +128,27 @@ def main() -> None:
         _bench_e2e(args)
         return
 
-    rec = _bench_step(args, bf16=args.bf16)
-    print(json.dumps(rec))
+    recs = _bench_step(args, bf16=args.bf16, extras=not args.primary_only)
+    print(json.dumps(recs[0]))
+    for rec in recs[1:]:
+        print(json.dumps(rec), file=sys.stderr)
     # Secondary bf16 record (driver runs fp32 only; without this the bf16
     # capability is invisible to BENCH_r*.json tails).  Real accelerators
     # only — CPU-mesh tests/sweeps stay single-measurement and fast.
     if not args.bf16 and not args.no_bf16 and \
-            jax.default_backend() != "cpu":
-        print(json.dumps(_bench_step(args, bf16=True)), file=sys.stderr)
+            args.profile_dir is None and jax.default_backend() != "cpu":
+        print(json.dumps(_bench_step(args, bf16=True, extras=False)[0]),
+              file=sys.stderr)
 
 
-def _bench_step(args, *, bf16: bool) -> dict:
-    """Steady-state jitted-step throughput on the requested mesh."""
+def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
+    """Steady-state train-step throughput on the requested mesh.  Returns
+    records, primary first.  ``--dispatch step`` (the default — measured
+    marginally FASTER than scan; negative result in BASELINE.md) issues
+    one dispatch per step, pipelined by JAX async dispatch;
+    ``--dispatch scan`` issues the window as ONE jitted ``lax.scan`` (the
+    resident-epoch mode's dispatch pattern).  With ``extras``, the other
+    flavor is also measured and reported (stderr)."""
     mesh = make_mesh(args.num_devices)
     n_chips = mesh.devices.size
     model = get_model(args.model)
@@ -139,31 +165,74 @@ def _bench_step(args, *, bf16: bool) -> dict:
     state = init_train_state(params, stats)
     rng = jax.random.key(0)
 
+    def time_windows(run_window) -> float:
+        """Best-of-repeats wall time of one window; syncs via a host read
+        of the last loss (block_until_ready alone has been observed to
+        return early through remote-device tunnels; a value read cannot)."""
+        dt = float("inf")
+        for _ in range(max(args.repeats, 1)):
+            t0 = time.perf_counter()
+            loss = run_window()
+            float(loss)
+            dt = min(dt, time.perf_counter() - t0)
+        return dt
+
+    def record(tag: str, dt: float) -> dict:
+        sps_chip = global_batch * args.steps / dt / n_chips
+        vs = sps_chip / BASELINE_BENCH if BASELINE_BENCH and not bf16 else 1.0
+        return {
+            "metric": f"{args.model} train samples/sec/chip "
+                      f"(batch {args.batch_size}/chip, "
+                      f"{'bf16' if bf16 else 'fp32'}, {n_chips} chip(s), "
+                      f"{tag})",
+            "value": round(sps_chip, 2),
+            "unit": "samples/sec/chip",
+            "vs_baseline": round(vs, 3),
+        }
+
+    def step_window():
+        nonlocal state
+        for _ in range(args.steps):
+            state, loss = step_fn(state, batch, rng)
+        return loss
+
     # At least one warmup step always runs (it also triggers compilation).
     for _ in range(max(args.warmup, 1)):
         state, loss = step_fn(state, batch, rng)
-    float(loss)  # full sync: device->host read of the dependency chain's end
-    dt = float("inf")
-    for _ in range(max(args.repeats, 1)):
-        t0 = time.perf_counter()
-        for _ in range(args.steps):
-            state, loss = step_fn(state, batch, rng)
-        # Sync via a host read of the last loss, which depends on every
-        # step.  (block_until_ready alone has been observed to return early
-        # through remote-device tunnels; a value read cannot.)
-        float(loss)
-        dt = min(dt, time.perf_counter() - t0)
+    float(loss)
 
-    sps_chip = global_batch * args.steps / dt / n_chips
-    vs = sps_chip / BASELINE_BENCH if BASELINE_BENCH and not bf16 else 1.0
-    return {
-        "metric": f"{args.model} train samples/sec/chip "
-                  f"(batch {args.batch_size}/chip, "
-                  f"{'bf16' if bf16 else 'fp32'}, {n_chips} chip(s))",
-        "value": round(sps_chip, 2),
-        "unit": "samples/sec/chip",
-        "vs_baseline": round(vs, 3),
-    }
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def scan_window_fn(state):
+        def body(st, _):
+            st, loss = step_fn(st, batch, rng)
+            return st, loss
+        state, losses = jax.lax.scan(body, state, None, length=args.steps)
+        return state, losses[-1]
+
+    def scan_window():
+        nonlocal state
+        state, loss = scan_window_fn(state)
+        return loss
+
+    step_tag = f"{args.steps}-step window, per-step dispatch"
+    scan_tag = f"{args.steps}-step scan dispatch (resident-epoch mode)"
+    primary_is_step = args.dispatch == "step"
+    if not primary_is_step or (extras and args.profile_dir is None):
+        float(scan_window())  # compile the scanned program when needed
+    primary = step_window if primary_is_step else scan_window
+    if args.profile_dir:
+        # One traced (untimed) window of the SELECTED flavor — tracing
+        # skews wall-clock, so it never sets dt.
+        jax.profiler.start_trace(args.profile_dir)
+        float(primary())
+        jax.profiler.stop_trace()
+    recs = [record(step_tag if primary_is_step else scan_tag,
+                   time_windows(primary))]
+    if extras and args.profile_dir is None:
+        other = scan_window if primary_is_step else step_window
+        recs.append(record(scan_tag if primary_is_step else step_tag,
+                           time_windows(other)))
+    return recs
 
 
 def _bench_sweep(args) -> None:
@@ -178,7 +247,11 @@ def _bench_sweep(args) -> None:
                  "--model", args.model, "--batch_size", str(args.batch_size),
                  "--steps", str(args.steps), "--warmup", str(args.warmup),
                  "--repeats", str(args.repeats), "--num_devices", str(n),
-                 "--no_bf16"] + (["--bf16"] if args.bf16 else [])
+                 "--no_bf16", "--primary_only",  # one program per child:
+                 # the secondary dispatch-flavor window would double each
+                 # child's (serial, CPU-bound) compile cost for no signal
+                 "--dispatch", args.dispatch]
+        child += ["--bf16"] if args.bf16 else []
         if args.sweep_platform == "cpu":
             from ddp_tpu.utils.platform import cpu_device_env
             env = cpu_device_env(n, env)

@@ -1,0 +1,112 @@
+"""Per-op summary of a jax.profiler trace — the analysis behind
+BASELINE.md's roofline table, as a reusable tool.
+
+The reference's only timing is two ``time.time()`` calls around training
+(singlegpu.py:232-234); this framework additionally captures XLA traces
+(``--profile_dir`` on the CLI, ``bench.py --profile_dir``) and this module
+turns a captured trace into the numbers that matter on TPU: device-busy
+time per step and the top ops by total device time, aggregated from the
+``.xplane.pb`` the profiler writes.
+
+Parsing uses the tensorflow-bundled xplane proto when available (the
+heavyweight tensorboard profile plugin in this image is version-skewed
+against its own pywrap helpers, so events are aggregated here directly);
+set ``PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python`` if the fast-proto
+runtime rejects the generated module.
+
+Usage:
+    python -m ddp_tpu.utils.profiling /tmp/prof [--steps 20] [--top 20]
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+def _load_xspaces(trace_dir: str) -> list:
+    """All .xplane.pb files of the newest capture session (multi-host
+    traces write one file per host; sessions are timestamped dirs)."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError as e:  # pragma: no cover - tf is baked into the image
+        raise RuntimeError(
+            "xplane parsing needs the tensorflow-bundled xplane proto; "
+            f"import failed: {e}")
+    sessions = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*")))
+    if not sessions:
+        raise FileNotFoundError(
+            f"no capture sessions under {trace_dir}/plugins/profile/ — "
+            "pass the directory given to jax.profiler.start_trace/"
+            "--profile_dir")
+    spaces = []
+    for path in sorted(glob.glob(os.path.join(sessions[-1],
+                                              "*.xplane.pb"))):
+        xs = xplane_pb2.XSpace()
+        xs.ParseFromString(open(path, "rb").read())
+        spaces.append(xs)
+    if not spaces:
+        raise FileNotFoundError(f"no .xplane.pb in {sessions[-1]}")
+    return spaces
+
+
+def device_op_summary(trace_dir: str, steps: int = 1,
+                      device_plane: Optional[str] = None
+                      ) -> Dict[str, List[Tuple[str, float, float]]]:
+    """Aggregate per-op device time from a trace.
+
+    Returns ``{"<plane>/<line>": [(op_name, total_ms, ms_per_step), ...]}``
+    for EVERY device plane with events (one per chip; multi-host captures
+    contribute one file per host), ops sorted by total time descending —
+    nothing is silently dropped on multi-chip traces.  ``device_plane``
+    restricts to one plane by exact name; ``steps`` divides totals into
+    per-step cost (the number of steps captured in the trace).
+    """
+    planes = [p for xs in _load_xspaces(trace_dir) for p in xs.planes
+              if (p.name == device_plane if device_plane
+                  else ("/device:" in p.name
+                        and any(len(ln.events) for ln in p.lines)))]
+    if not planes:
+        raise ValueError(f"no matching device plane with events in "
+                         f"{trace_dir}")
+    out: Dict[str, List[Tuple[str, float, float]]] = {}
+    for plane in planes:
+        for line in plane.lines:
+            totals: collections.Counter = collections.Counter()
+            for ev in line.events:
+                totals[plane.event_metadata[ev.metadata_id].name] += \
+                    ev.duration_ps
+            out[f"{plane.name}/{line.name}"] = [
+                (name, ps / 1e9, ps / 1e9 / max(steps, 1))
+                for name, ps in totals.most_common()]
+    return out
+
+
+def print_summary(trace_dir: str, steps: int = 1, top: int = 20) -> None:
+    summary = device_op_summary(trace_dir, steps=steps)
+    for line_name, ops in summary.items():
+        if not ops:
+            continue
+        total_ms = sum(t for _, t, _ in ops)
+        print(f"--- {line_name}: {len(ops)} distinct ops, "
+              f"{total_ms:.2f} ms total, {total_ms / max(steps, 1):.3f} "
+              "ms/step")
+        for name, tot, per in ops[:top]:
+            print(f"  {per:8.3f} ms/step  {name[:100]}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace_dir")
+    p.add_argument("--steps", type=int, default=1,
+                   help="Steps captured in the trace (divides totals)")
+    p.add_argument("--top", type=int, default=20)
+    args = p.parse_args()
+    print_summary(args.trace_dir, steps=args.steps, top=args.top)
+
+
+if __name__ == "__main__":
+    main()

