@@ -156,14 +156,19 @@ def spawn_local(num_processes: int) -> int:
             skip = "=" not in a  # bare flag consumes the following N
             continue
         argv.append(a)
+    # Re-exec the current command.  A plain script (python multigpu.py ...)
+    # needs the interpreter prepended; an installed console shim
+    # (ddp-tpu-multi, possibly a binary launcher) is itself executable and
+    # must NOT be fed to python.
+    cmd = ([sys.executable, sys.argv[0]] if sys.argv[0].endswith(".py")
+           else [sys.argv[0]])
     procs = []
     for pid in range(num_processes):
         env = dict(os.environ,
                    DDP_TPU_COORDINATOR=f"localhost:{port}",
                    DDP_TPU_NUM_PROCESSES=str(num_processes),
                    DDP_TPU_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, sys.argv[0], *argv], env=env))
+        procs.append(subprocess.Popen([*cmd, *argv], env=env))
     return max(p.wait() for p in procs)
 
 

@@ -1,0 +1,20 @@
+"""Installed console entry points (``pip install .`` exposes the two
+reference-shaped commands without needing the repo-root scripts).
+
+``ddp-tpu-single`` == ``python singlegpu.py`` (mesh of 1,
+singlegpu.py:254-263); ``ddp-tpu-multi`` == ``python multigpu.py``
+(all devices, multigpu.py:254-263).  Identical argv surface.
+"""
+from __future__ import annotations
+
+from .cli import build_parser, main
+
+
+def main_single() -> None:
+    main(build_parser("single-device distributed training job").parse_args(),
+         num_devices=1)
+
+
+def main_multi() -> None:
+    main(build_parser("simple distributed training job").parse_args(),
+         num_devices=None)

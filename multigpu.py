@@ -13,8 +13,7 @@ DDP_TPU_COORDINATOR/NUM_PROCESSES/PROCESS_ID (ddp_tpu/parallel/dist.py);
 ``--spawn N`` forks N wired local processes — the reference's ``mp.spawn``
 UX — with per-process device visibility left to the environment.
 """
-from ddp_tpu.cli import build_parser, main
+from ddp_tpu.entry import main_multi
 
 if __name__ == "__main__":
-    args = build_parser("simple distributed training job").parse_args()
-    main(args, num_devices=None)  # all devices
+    main_multi()  # all devices; same body as the installed ddp-tpu-multi

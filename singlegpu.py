@@ -6,8 +6,7 @@
 On TPU the single-device path is just a mesh of one chip running the same
 jitted train step as the distributed path (SURVEY.md §7 design stance).
 """
-from ddp_tpu.cli import build_parser, main
+from ddp_tpu.entry import main_single
 
 if __name__ == "__main__":
-    args = build_parser("single-device distributed training job").parse_args()
-    main(args, num_devices=1)
+    main_single()  # mesh of 1; same body as the installed ddp-tpu-single

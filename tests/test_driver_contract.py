@@ -50,6 +50,27 @@ def test_graft_entry_compiles():
 
 
 @pytest.mark.slow
+def test_bench_sweep_contract():
+    """--sweep N1,N2: one child per device count on its own virtual CPU
+    mesh, one summary JSON line with per-N rates (the scaling-readiness
+    harness BASELINE.md records)."""
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--sweep", "1,2", "--model", "deepnn",
+         "--batch_size", "8", "--steps", "2", "--warmup", "1",
+         "--repeats", "1"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "samples_per_sec_per_chip"}
+    assert set(rec["samples_per_sec_per_chip"]) == {"1", "2"}
+    assert all(v > 0 for v in rec["samples_per_sec_per_chip"].values())
+
+
+@pytest.mark.slow
 def test_graft_dryrun_multichip():
     """dryrun_multichip(8) must jit + execute the full DP train step over
     the 8-device mesh (the conftest CPU fake of a TPU slice)."""

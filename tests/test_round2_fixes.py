@@ -90,3 +90,20 @@ def test_async_save_failure_single_host_just_raises(monkeypatch, capsys):
     with pytest.raises(OSError, match="disk full"):
         t._join_pending_save()
     assert not shutdowns and "FATAL" not in capsys.readouterr().err
+
+
+def test_console_entry_points(monkeypatch):
+    """Installed commands (pyproject [project.scripts]) delegate to the
+    same CLI body with the entry-point-specific mesh size."""
+    import sys
+
+    from ddp_tpu import entry
+
+    calls = []
+    monkeypatch.setattr(
+        cli, "run", lambda args, num_devices: calls.append(
+            (args.total_epochs, args.save_every, num_devices)))
+    monkeypatch.setattr(sys, "argv", ["prog", "3", "2"])
+    entry.main_single()
+    entry.main_multi()
+    assert calls == [(3, 2, 1), (3, 2, None)]
