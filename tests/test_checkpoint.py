@@ -55,21 +55,23 @@ def test_roundtrip(tmp_path):
                 jax.device_get(state.opt_state.momentum_buf)))
 
 
-def _make_trainer(path, epochs, seed=0, resume=False):
+def _make_trainer(path, epochs, seed=0, resume=False, mesh_size=8,
+                  per_replica=8, shard_update=False):
     train_ds, _ = synthetic(n_train=256, seed=1)
-    mesh = make_mesh(8)
+    mesh = make_mesh(mesh_size)
     # DeepNN: much cheaper to train on the CPU mesh than VGG, and its
     # dropout additionally pins that the rng stream (keyed off the restored
     # step counter) continues identically across a resume.
     model = get_model("deepnn")
     params, stats = model.init(jax.random.key(seed))
-    loader = TrainLoader(train_ds, per_replica_batch=8, num_replicas=8,
-                         seed=seed)
+    loader = TrainLoader(train_ds, per_replica_batch=per_replica,
+                         num_replicas=mesh_size, seed=seed)
     sched = functools.partial(triangular_lr, base_lr=0.05, num_epochs=epochs,
                               steps_per_epoch=len(loader))
     return Trainer(model, loader, params, stats, mesh=mesh, lr_schedule=sched,
                    sgd_config=SGDConfig(lr=0.05), save_every=1,
-                   snapshot_path=path, resume=resume)
+                   snapshot_path=path, resume=resume,
+                   shard_update=shard_update)
 
 
 def test_resume_continues_exactly(tmp_path):
@@ -96,6 +98,37 @@ def test_resume_continues_exactly(tmp_path):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
                                       err_msg=str(pa))
     assert int(t_full.state.step) == int(t_res.state.step)
+
+
+def test_resume_across_mesh_sizes_and_modes(tmp_path):
+    """The checkpoint is a replicated canonical pytree, so it restores
+    onto a DIFFERENT mesh size and even a different update mode — an
+    elastic-ish capability the reference's per-rank DDP state cannot
+    offer.  1 epoch on 8 devices (plain DP) -> resume on a 2-device mesh
+    with weight-update sharding at the same global batch (8x8 == 2x32, so
+    the LR schedule's step geometry is unchanged) -> the second epoch
+    trains to completion."""
+    path = str(tmp_path / "ck.pt")
+    t8 = _make_trainer(path, epochs=2)
+    t8.train(1)
+
+    ck = load_checkpoint(path)
+    t2 = _make_trainer(path, epochs=2, resume=True, mesh_size=2,
+                       per_replica=32, shard_update=True)
+    assert t2.start_epoch == 1
+    # Restored params match the file bit-for-bit before further training.
+    for (pw, w), (pg, g) in zip(
+            jax.tree_util.tree_leaves_with_path(ck.params),
+            jax.tree_util.tree_leaves_with_path(
+                jax.device_get(t2.state.params))):
+        assert pw == pg
+        np.testing.assert_array_equal(np.asarray(w), np.asarray(g))
+    t2.train(2)
+    assert int(t2.state.step) == 2 * len(t2.train_loader)
+    assert all(np.isfinite(l) for l in t2.loss_history)
+    # The continued run's checkpoint is canonical again (mode-agnostic).
+    ck2 = load_checkpoint(path)
+    assert ck2.epoch == 1 and ck2.step == int(t2.state.step)
 
 
 def test_async_save_error_surfaces(tmp_path):
