@@ -197,3 +197,52 @@ def test_augmentation_topology_invariant():
                                     half1.materialize(k)["label"]])
             np.testing.assert_array_equal(want["image"], got_i)
             np.testing.assert_array_equal(want["label"], got_l)
+
+
+def test_sampler_properties_randomized_vs_torch():
+    """Property-based sweep of (n, world, epoch, shuffle, drop_last)
+    against torch.utils.data.DistributedSampler: per-rank lengths, padding
+    count, coverage, and (without shuffle) index-exactness — the same
+    invariants as the parametrized cases above, over a randomized grid."""
+    hypothesis = pytest.importorskip("hypothesis")
+    st = hypothesis.strategies
+
+    @hypothesis.settings(max_examples=40, deadline=None)
+    @hypothesis.given(n=st.integers(1, 4000), world=st.integers(1, 16),
+                      epoch=st.integers(0, 5), shuffle=st.booleans(),
+                      drop_last=st.booleans())
+    def check(n, world, epoch, shuffle, drop_last):
+        # n < world with drop_last is a valid degenerate case in both
+        # implementations: every shard is empty (num_samples == 0).
+        t_all, o_all = [], []
+        for rank in range(world):
+            ts = DistributedSampler(_FakeDataset(n), num_replicas=world,
+                                    rank=rank, shuffle=shuffle, seed=0,
+                                    drop_last=drop_last)
+            ts.set_epoch(epoch)
+            t = np.asarray(list(iter(ts)))
+            ours = DistributedShardSampler(n, world, rank, shuffle=shuffle,
+                                           seed=0, drop_last=drop_last)
+            ours.set_epoch(epoch)
+            o = ours.indices()
+            assert len(ours) == ts.num_samples
+            assert o.shape == t.shape
+            if not shuffle:
+                np.testing.assert_array_equal(t, o)
+            t_all.append(t)
+            o_all.append(o)
+        t_cat, o_cat = np.concatenate(t_all), np.concatenate(o_all)
+        if shuffle and drop_last and n % world:
+            # Truncating a permutation: WHICH elements drop is
+            # RNG-specific (torch's Philox vs our PCG64) — the invariant
+            # is distinctness and the torch-equal truncated size.
+            assert len(np.unique(o_cat)) == len(o_cat) == len(t_cat)
+            assert set(o_cat.tolist()) <= set(range(n))
+        else:
+            # Same coverage and same number of padded repeats (the
+            # concrete repeated elements are RNG-specific, as in torch).
+            assert set(o_cat.tolist()) == set(t_cat.tolist())
+            assert (len(o_cat) - len(np.unique(o_cat))
+                    == len(t_cat) - len(np.unique(t_cat)))
+
+    check()

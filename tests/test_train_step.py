@@ -163,9 +163,11 @@ def test_golden_trace_full_lr_triangle():
     assert lr16 == 0.0
 
 
-def _golden_run(n_batch, base_lr, spe, steps, seed=21):
+def _golden_run(n_batch, base_lr, spe, steps, seed=21, torch_side=True):
     """Lockstep JAX-vs-torch trajectory at the given recipe; returns
-    (jax_losses, torch_losses, jax_params, torch_params)."""
+    (jax_losses, torch_losses, jax_params, torch_params).  With
+    ``torch_side=False`` only the JAX trajectory runs (torch still
+    supplies the initial weights) — torch_losses/torch_params are None."""
     from ddp_tpu.data import synthetic as synthetic_ds
     torch.manual_seed(2)
     tmodel = TorchVGG()
@@ -192,17 +194,43 @@ def _golden_run(n_batch, base_lr, spe, steps, seed=21):
         state, loss = step_fn(state, batch, jax.random.key(0))
         jax_losses.append(float(loss))
 
-        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
-        ty = torch.from_numpy(y.astype(np.int64))
-        opt.zero_grad()
-        tloss = F.cross_entropy(tmodel(tx), ty)
-        tloss.backward()
-        opt.step()
-        lr_sched.step()
-        torch_losses.append(tloss.item())
+        if torch_side:
+            tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+            ty = torch.from_numpy(y.astype(np.int64))
+            opt.zero_grad()
+            tloss = F.cross_entropy(tmodel(tx), ty)
+            tloss.backward()
+            opt.step()
+            lr_sched.step()
+            torch_losses.append(tloss.item())
+    if not torch_side:
+        return np.asarray(jax_losses), None, jax.device_get(state.params), \
+            None
     want, _ = torch_interop.vgg_from_torch_state_dict(tmodel.state_dict())
     return (np.asarray(jax_losses), np.asarray(torch_losses),
             jax.device_get(state.params), want)
+
+
+@pytest.mark.slow
+def test_golden_trace_recorded_artifact():
+    """Torch-free regression pin: the exact-recipe prefix (batch 512,
+    lr 0.4, spe 98) against the RECORDED trace in tests/golden/ — ~150 s
+    (4 jitted batch-512 steps on this 1-core box), roughly half the full
+    lockstep comparison below, and it keeps guarding the numerics even in
+    an environment without torch.  rtol 1e-4: tight enough that any
+    semantic change (init, wd placement, LR indexing, BN formulation)
+    fails immediately, loose enough for ULP-level drift across XLA
+    versions (a legitimate XLA upgrade that shifts numerics beyond 1e-4
+    should be re-recorded consciously, not absorbed silently)."""
+    import json
+    import os
+    golden = json.load(open(os.path.join(
+        os.path.dirname(__file__), "golden", "exact_recipe_prefix.json")))
+    cfg = golden["config"]
+    jl, _, _, _ = _golden_run(
+        n_batch=cfg["batch"], base_lr=cfg["base_lr"],
+        spe=cfg["steps_per_epoch"], steps=cfg["steps"], torch_side=False)
+    np.testing.assert_allclose(jl, golden["losses"], rtol=1e-4)
 
 
 @pytest.mark.slow
