@@ -85,6 +85,10 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--profile_dir", default=None,
                    help="Capture a jax.profiler trace of the training loop "
                         "into this directory (view with TensorBoard)")
+    p.add_argument("--tensorboard_dir", default=None,
+                   help="Also mirror the per-step loss/LR (and periodic "
+                        "eval accuracy) as TensorBoard scalars into this "
+                        "directory (rank 0; needs tensorflow)")
     p.add_argument("--device_augment", action="store_true",
                    help="Run RandomCrop+HFlip on the TPU inside the train "
                         "step instead of on the host (same distribution)")
@@ -313,7 +317,21 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     opt_steps = -(-len(train_loader) // max(args.grad_accum, 1))
     lr_schedule = build_schedule(args, opt_steps)
 
-    metrics = MetricsLogger(args.metrics_path)
+    if args.tensorboard_dir:
+        # Validate the lazy tf dependency on EVERY rank: if only rank 0
+        # (the writer rank) exited over a missing tensorflow, ranks 1+
+        # would hang in their first collective.
+        try:
+            import tensorflow  # noqa: F401
+        except ImportError as e:
+            raise SystemExit(
+                f"--tensorboard_dir needs tensorflow for tf.summary: {e}")
+    # Event-file creation is itself a write, so the TB writer (unlike the
+    # append-only JSONL handle) is constructed on rank 0 only.
+    metrics = MetricsLogger(
+        args.metrics_path,
+        tensorboard_dir=(args.tensorboard_dir
+                         if jax.process_index() == 0 else None))
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
                       lr_schedule=lr_schedule, sgd_config=SGDConfig(lr=args.lr),
                       save_every=args.save_every,
@@ -357,12 +375,18 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     start = time.time()
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
-    trainer.train(args.total_epochs,
-                  epoch_callback=_epoch_callback if args.eval_every else None)
-    if args.profile_dir:
-        jax.profiler.stop_trace()
+    try:
+        trainer.train(
+            args.total_epochs,
+            epoch_callback=_epoch_callback if args.eval_every else None)
+    finally:
+        # A mid-run failure must still land the buffered telemetry: the
+        # tf.summary writer buffers minutes of scalars (the JSONL handle
+        # is line-buffered), and an un-stopped profiler trace is empty.
+        metrics.close()
+        if args.profile_dir:
+            jax.profiler.stop_trace()
     training_time = time.time() - start
-    metrics.close()
     # Reference report block (multigpu.py:230-248).
     print(f"Total training time: {training_time:.2f} seconds")
     fp32_model_size = get_model_size(trainer.state.params, 32)

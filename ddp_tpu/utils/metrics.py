@@ -9,6 +9,11 @@ stream, so the survey flags per-step loss emission as a required addition.
 effective LR, wall-clock seconds since construction.  Process-0 only (the
 same gate as checkpoint writes, multigpu.py:118) — values are replicated
 across the mesh, so one writer suffices.
+
+``tensorboard_dir`` additionally mirrors the stream as TensorBoard scalars
+(``train/loss``, ``train/lr``, ``eval/accuracy``) via ``tf.summary``;
+tensorflow is imported lazily and only when the option is used — the
+framework itself carries no tf dependency.
 """
 from __future__ import annotations
 
@@ -18,36 +23,59 @@ from typing import IO, Optional
 
 
 class MetricsLogger:
-    def __init__(self, path: Optional[str], enabled: bool = True):
+    def __init__(self, path: Optional[str], enabled: bool = True,
+                 tensorboard_dir: Optional[str] = None):
         self.path = path
         self._f: Optional[IO[str]] = None
+        self._tb = None
         self._t0 = time.time()
-        if path and enabled:
+        if not enabled:
+            return
+        if path:
             self._f = open(path, "a", buffering=1)  # line-buffered
+        if tensorboard_dir:
+            try:
+                import tensorflow as tf  # lazy: only this option needs it
+            except ImportError as e:
+                raise SystemExit(
+                    "--tensorboard_dir needs tensorflow for tf.summary "
+                    f"event files: {e}")
+            self._tf = tf
+            self._tb = tf.summary.create_file_writer(tensorboard_dir)
 
     def log_step(self, *, step: int, epoch: int, loss: float,
                  lr: float) -> None:
-        if self._f is None:
-            return
-        self._f.write(json.dumps({
-            "step": step, "epoch": epoch, "loss": round(loss, 6),
-            "lr": round(lr, 8), "wall_s": round(time.time() - self._t0, 3),
-        }) + "\n")
+        if self._f is not None:
+            self._f.write(json.dumps({
+                "step": step, "epoch": epoch, "loss": round(loss, 6),
+                "lr": round(lr, 8),
+                "wall_s": round(time.time() - self._t0, 3),
+            }) + "\n")
+        if self._tb is not None:
+            with self._tb.as_default():
+                self._tf.summary.scalar("train/loss", loss, step=step)
+                self._tf.summary.scalar("train/lr", lr, step=step)
 
     def log_eval(self, *, epoch: int, accuracy: float) -> None:
         """Periodic-eval record (--eval_every; absent in the reference,
         which evaluates once after training — multigpu.py:247)."""
-        if self._f is None:
-            return
-        self._f.write(json.dumps({
-            "epoch": epoch, "eval_accuracy": round(accuracy, 4),
-            "wall_s": round(time.time() - self._t0, 3),
-        }) + "\n")
+        if self._f is not None:
+            self._f.write(json.dumps({
+                "epoch": epoch, "eval_accuracy": round(accuracy, 4),
+                "wall_s": round(time.time() - self._t0, 3),
+            }) + "\n")
+        if self._tb is not None:
+            with self._tb.as_default():
+                self._tf.summary.scalar("eval/accuracy", accuracy,
+                                        step=epoch)
 
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
             self._f = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def __enter__(self):
         return self

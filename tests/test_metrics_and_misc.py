@@ -97,3 +97,29 @@ def test_eval_loader_local_replicas_partition():
         for key in ("image", "label", "mask"):
             np.testing.assert_array_equal(
                 glob[key], np.concatenate([l[key] for l in locs]))
+
+
+def test_metrics_tensorboard_mirror(tmp_path):
+    """--tensorboard_dir mirrors the stream as tf.summary scalars: the
+    event file exists and contains the train/loss, train/lr, and
+    eval/accuracy tags."""
+    import glob
+
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+
+    tb = str(tmp_path / "tb")
+    with MetricsLogger(str(tmp_path / "m.jsonl"),
+                       tensorboard_dir=tb) as m:
+        m.log_step(step=0, epoch=0, loss=2.3, lr=0.1)
+        m.log_step(step=1, epoch=0, loss=2.1, lr=0.2)
+        m.log_eval(epoch=0, accuracy=42.0)
+    events = glob.glob(tb + "/events.out.tfevents.*")
+    assert len(events) == 1
+    tags = set()
+    for rec in tf.compat.v1.train.summary_iterator(events[0]):
+        for v in rec.summary.value:
+            tags.add(v.tag)
+    assert {"train/loss", "train/lr", "eval/accuracy"} <= tags
+    # And the JSONL stream is unaffected by the mirror.
+    assert len(open(str(tmp_path / "m.jsonl")).readlines()) == 3
