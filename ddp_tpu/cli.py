@@ -148,9 +148,11 @@ def spawn_local(num_processes: int) -> int:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     # Strip --spawn in every argparse-accepted spelling, including
-    # unambiguous abbreviations (--spa/--spaw; allow_abbrev is on) — a
-    # surviving spelling would make every child re-spawn recursively.
-    spawn_re = re.compile(r"--spa(w|wn)?(=.*)?$")
+    # unambiguous abbreviations (--sp/--spa/--spaw; allow_abbrev is on and
+    # no other option starts with "--sp") — a surviving spelling would make
+    # every child re-spawn recursively (the DDP_TPU_PROCESS_ID check in
+    # main() is the backstop, but this function must be safe on its own).
+    spawn_re = re.compile(r"--sp(a(wn?)?)?(=.*)?$")
     argv, skip = [], False
     for a in sys.argv[1:]:
         if skip:
@@ -348,6 +350,10 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     resident_test_cache: list = []  # test set uploaded to HBM at most once
 
     def _eval(progress: bool) -> float:
+        # Evaluation computes in the SAME precision as training (the
+        # reference evaluates the very model it trained, multigpu.py:247)
+        # — under --bf16 that is bf16, which also halves eval's HBM
+        # traffic; params themselves are stored fp32 either way.
         if args.resident:
             from .data.resident import ResidentData
             from .train.evaluate import evaluate_resident
@@ -355,10 +361,13 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                 resident_test_cache.append(ResidentData(test_ds, mesh))
             return evaluate_resident(
                 model, trainer.state.params, trainer.state.batch_stats,
-                resident_test_cache[0], eval_loader, mesh)
+                resident_test_cache[0], eval_loader, mesh,
+                compute_dtype=compute_dtype)
         return evaluate(model, trainer.state.params,
                         trainer.state.batch_stats, eval_loader, mesh,
-                        progress=progress)
+                        compute_dtype=compute_dtype, progress=progress)
+
+    last_periodic_eval: list = []  # [(epoch, accuracy)] — newest only
 
     def _epoch_callback(epoch: int) -> None:
         # --eval_every: periodic validation (no reference analogue — it
@@ -368,6 +377,7 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         # stream, keeping the two metric streams consistent on multi-host.
         if args.eval_every and (epoch + 1) % args.eval_every == 0:
             acc = _eval(progress=False)
+            last_periodic_eval[:] = [(epoch, acc)]
             if jax.process_index() == 0:
                 print(f"Epoch {epoch} | eval accuracy={acc:.2f}%")
                 metrics.log_eval(epoch=epoch, accuracy=acc)
@@ -376,24 +386,42 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
     try:
-        trainer.train(
-            args.total_epochs,
-            epoch_callback=_epoch_callback if args.eval_every else None)
+        try:
+            trainer.train(
+                args.total_epochs,
+                epoch_callback=_epoch_callback if args.eval_every else None)
+        finally:
+            # Stop the trace at the end of TRAINING (its documented scope),
+            # even on a mid-run failure — an un-stopped trace is empty.
+            if args.profile_dir:
+                jax.profiler.stop_trace()
+        training_time = time.time() - start
+        # Reference report block (multigpu.py:230-248).
+        print(f"Total training time: {training_time:.2f} seconds")
+        fp32_model_size = get_model_size(trainer.state.params, 32)
+        print(f"fp32 model has size={fp32_model_size/MiB:.2f} MiB")
+        if args.export_torch and jax.process_index() == 0:
+            _export_torch(args.model, args.export_torch, trainer)
+        # When --eval_every already evaluated after the last epoch, the
+        # weights are unchanged — reuse that accuracy instead of a second
+        # identical full-test-set collective (minutes at scale).  Every
+        # process took the same branch, so multi-host stays in lockstep.
+        if last_periodic_eval and \
+                last_periodic_eval[0][0] == args.total_epochs - 1:
+            accuracy = last_periodic_eval[0][1]
+        else:
+            accuracy = _eval(progress=True)  # reference tqdm, multigpu.py:190
+        print(f"fp32 model has accuracy={accuracy:.2f}%")
+        if jax.process_index() == 0:
+            # The run's headline metric (the accuracy print the reference
+            # emits, multigpu.py:247-248) lands in the metrics stream too —
+            # the last JSONL/TensorBoard record of the run.
+            metrics.log_eval(epoch=args.total_epochs - 1, accuracy=accuracy,
+                             final=True)
     finally:
         # A mid-run failure must still land the buffered telemetry: the
         # tf.summary writer buffers minutes of scalars (the JSONL handle
-        # is line-buffered), and an un-stopped profiler trace is empty.
+        # is line-buffered).
         metrics.close()
-        if args.profile_dir:
-            jax.profiler.stop_trace()
-    training_time = time.time() - start
-    # Reference report block (multigpu.py:230-248).
-    print(f"Total training time: {training_time:.2f} seconds")
-    fp32_model_size = get_model_size(trainer.state.params, 32)
-    print(f"fp32 model has size={fp32_model_size/MiB:.2f} MiB")
-    if args.export_torch and jax.process_index() == 0:
-        _export_torch(args.model, args.export_torch, trainer)
-    accuracy = _eval(progress=True)  # reference's tqdm bar, multigpu.py:190
-    print(f"fp32 model has accuracy={accuracy:.2f}%")
     dist.shutdown()  # reference destroy_process_group (multigpu.py:250)
     return accuracy

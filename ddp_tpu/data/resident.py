@@ -18,6 +18,8 @@ sees exactly rank r's reference data stream.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import numpy as np
 
@@ -25,6 +27,24 @@ from jax.sharding import Mesh
 
 from ..parallel.mesh import replicated_sharding
 from .cifar10 import Dataset
+
+# Fraction of a device's HBM the replicated dataset may occupy.  The rest
+# is headroom for params/momentum/activations and XLA scratch — CIFAR-scale
+# data (~150 MB vs ~16 GB HBM) never comes near it; the guard exists so a
+# too-large dataset fails with instructions instead of a raw XLA OOM
+# mid-upload (the reference's streaming loop, multigpu.py:104-107, has no
+# such cliff and the superset must not add one).
+HBM_BUDGET_FRACTION = 0.8
+
+
+def _device_bytes_limit(device) -> Optional[int]:
+    """Per-device memory capacity in bytes, or None when the backend does
+    not report one (the CPU backend; tests monkeypatch this seam)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:  # backend without memory_stats support
+        return None
+    return (stats or {}).get("bytes_limit")
 
 
 class ResidentData:
@@ -34,12 +54,45 @@ class ResidentData:
     train step (train/step.py ``_as_input``), so HBM holds the dataset at
     1/4 fp32 size.  Multi-host: every process passes its (identical) host
     copy and the replicated global array is assembled process-locally.
+
+    Raises :class:`ValueError` before any upload when the dataset would not
+    fit the per-device HBM budget — resident mode replicates the FULL
+    dataset on every device, so capacity does not grow with the mesh; the
+    streaming loader is the mode for datasets beyond HBM.
     """
 
     def __init__(self, dataset: Dataset, mesh: Mesh):
         rep = replicated_sharding(mesh)
         images = np.ascontiguousarray(dataset.images)
         labels = np.ascontiguousarray(dataset.labels, dtype=np.int32)
+        # Probe an ADDRESSABLE device: under multi-host, mesh device 0
+        # belongs to process 0 only, and a non-addressable device's
+        # memory_stats raises.  The guard must make the SAME decision on
+        # every process (a rank that raises while others proceed leaves
+        # the others hanging in the assembly collective), so multi-host
+        # runs agree on the global minimum limit — with "no limit
+        # reported anywhere" disabling the guard everywhere.
+        local = [d for d in mesh.devices.flat
+                 if d.process_index == jax.process_index()]
+        limit = _device_bytes_limit(local[0]) if local else None
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            limits = multihost_utils.process_allgather(
+                np.asarray(-1 if limit is None else limit, np.int64))
+            limit = (None if (limits < 0).any()
+                     else int(np.min(limits)))
+        needed = images.nbytes + labels.nbytes
+        if limit is not None and needed > HBM_BUDGET_FRACTION * limit:
+            raise ValueError(
+                f"resident mode replicates the whole dataset into every "
+                f"device's HBM, but this dataset is "
+                f"{needed / 2**20:,.0f} MiB and the per-device budget is "
+                f"{HBM_BUDGET_FRACTION * limit / 2**20:,.0f} MiB "
+                f"({HBM_BUDGET_FRACTION:.0%} of {limit / 2**20:,.0f} MiB "
+                f"HBM, the rest reserved for params/activations). "
+                f"Drop --resident to stream batches from the host "
+                f"(optionally with --device_augment), or shrink the "
+                f"dataset.")
         if jax.process_count() == 1:
             self.images = jax.device_put(images, rep)
             self.labels = jax.device_put(labels, rep)

@@ -56,14 +56,18 @@ class MetricsLogger:
                 self._tf.summary.scalar("train/loss", loss, step=step)
                 self._tf.summary.scalar("train/lr", lr, step=step)
 
-    def log_eval(self, *, epoch: int, accuracy: float) -> None:
-        """Periodic-eval record (--eval_every; absent in the reference,
-        which evaluates once after training — multigpu.py:247)."""
+    def log_eval(self, *, epoch: int, accuracy: float,
+                 final: bool = False) -> None:
+        """Eval-accuracy record: periodic (--eval_every) or, with
+        ``final=True``, the end-of-run accuracy the reference prints
+        (multigpu.py:247-248) — the run's headline metric, landed as the
+        last record of the stream."""
         if self._f is not None:
-            self._f.write(json.dumps({
-                "epoch": epoch, "eval_accuracy": round(accuracy, 4),
-                "wall_s": round(time.time() - self._t0, 3),
-            }) + "\n")
+            rec = {"epoch": epoch, "eval_accuracy": round(accuracy, 4),
+                   "wall_s": round(time.time() - self._t0, 3)}
+            if final:
+                rec["final"] = True
+            self._f.write(json.dumps(rec) + "\n")
         if self._tb is not None:
             with self._tb.as_default():
                 self._tf.summary.scalar("eval/accuracy", accuracy,

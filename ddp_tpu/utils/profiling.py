@@ -46,7 +46,8 @@ def _load_xspaces(trace_dir: str) -> list:
     for path in sorted(glob.glob(os.path.join(sessions[-1],
                                               "*.xplane.pb"))):
         xs = xplane_pb2.XSpace()
-        xs.ParseFromString(open(path, "rb").read())
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
         spaces.append(xs)
     if not spaces:
         raise FileNotFoundError(f"no .xplane.pb in {sessions[-1]}")

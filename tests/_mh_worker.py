@@ -24,7 +24,15 @@ assert periodic-eval prints/records are rank-0-gated across real processes.
 import os
 import sys
 
-os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+# Topology from the spawning test (default: the original 2 hosts x 4
+# devices; test_four_process_matches_single_process uses 4 x 2 to exercise
+# rank >= 2 per-host column assembly).  The global mesh is always 8 wide,
+# so every topology checkpoints identically to the single-process run.
+_LOCAL_DEVICES = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
+_NUM_PROCESSES = int(os.environ.get("MH_NUM_PROCESSES", "2"))
+
+os.environ["XLA_FLAGS"] = (
+    f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
@@ -36,8 +44,10 @@ def main() -> None:
     pid, coordinator, ckpt_path = (int(sys.argv[1]), sys.argv[2], sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "streaming"
     from ddp_tpu.parallel import dist
-    dist.initialize(coordinator=coordinator, num_processes=2, process_id=pid)
-    assert jax.process_count() == 2 and jax.device_count() == 8
+    dist.initialize(coordinator=coordinator, num_processes=_NUM_PROCESSES,
+                    process_id=pid)
+    assert jax.process_count() == _NUM_PROCESSES
+    assert jax.device_count() == _NUM_PROCESSES * _LOCAL_DEVICES
 
     if mode == "cli":
         # Full CLI path on 2 real processes: the periodic eval is a
@@ -60,7 +70,7 @@ def main() -> None:
     from ddp_tpu.parallel import make_mesh
     from ddp_tpu.train import Trainer
 
-    mesh = make_mesh()  # all 8 devices across both processes
+    mesh = make_mesh()  # all 8 devices across all processes
     model = get_model("deepnn")
     params, stats = model.init(jax.random.key(0))
     train_ds, _ = synthetic(n_train=128, seed=5)

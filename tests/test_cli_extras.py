@@ -124,4 +124,8 @@ def test_eval_every(tmp_path, capsys, monkeypatch):
     assert "Epoch 1 | eval accuracy=" in out
     evals = [json.loads(l) for l in open("m.jsonl")
              if "eval_accuracy" in l]
-    assert [e["epoch"] for e in evals] == [0, 1]
+    # Two periodic records plus the end-of-run headline accuracy (the
+    # reference's final print, multigpu.py:247-248) as the LAST record.
+    assert [e["epoch"] for e in evals] == [0, 1, 1]
+    assert evals[-1].get("final") is True
+    assert not any(e.get("final") for e in evals[:-1])

@@ -30,8 +30,9 @@ def test_resident_eval_test_set_uploaded_once(tmp_path, monkeypatch):
          "--lr", "0.05", "--num_devices", "2", "--synthetic_size", "32",
          "--resident", "--eval_every", "1", "--snapshot_path", "none.pt"])
     cli.run(args, num_devices=None)
-    # 3 evals ran (epoch 0, epoch 1, final) but only 2 uploads happened:
-    # the train set and the test set, once each.
+    # Evals ran at epoch 0 and epoch 1 (the final report reuses epoch 1's
+    # collective result) but only 2 uploads happened: the train set and
+    # the test set, once each.
     assert len(uploads) == 2
 
 
