@@ -17,8 +17,8 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import initializers as init_lib
-from ..ops.layers import (BatchNormState, batch_norm, conv2d, global_avg_pool,
-                          linear, max_pool)
+from ..ops.layers import (BatchNormState, batch_norm, bn_relu, conv2d,
+                          global_avg_pool, linear, max_pool)
 
 NAME = "resnet18"
 NUM_CLASSES = 10
@@ -77,9 +77,12 @@ def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Dict, Dict]:
     return params, stats
 
 
-def _bn_apply(p, st, x, train, new_stats, key_out):
-    y, new_st = batch_norm(x, p["scale"], p["bias"],
-                           BatchNormState(st["mean"], st["var"]), train=train)
+def _bn_apply(p, st, x, train, new_stats, key_out, relu=False):
+    """BN (+ fused ReLU where one immediately follows — bn1 spots; the
+    bn2/shortcut outputs feed the residual add first, so they stay bare)."""
+    op = bn_relu if relu else batch_norm
+    y, new_st = op(x, p["scale"], p["bias"],
+                   BatchNormState(st["mean"], st["var"]), train=train)
     new_stats[key_out] = {"mean": new_st.mean, "var": new_st.var}
     return y
 
@@ -94,8 +97,8 @@ def apply(params: Dict, batch_stats: Dict, x: jax.Array, *, train: bool,
     new_stats: Dict[str, Any] = {}
 
     x = conv2d(x, params["conv1"]["kernel"].astype(cd), stride=2, padding=3)
-    x = _bn_apply(params["bn1"], batch_stats["bn1"], x, train, new_stats, "bn1")
-    x = jax.nn.relu(x)
+    x = _bn_apply(params["bn1"], batch_stats["bn1"], x, train, new_stats,
+                  "bn1", relu=True)
     x = max_pool(x, window=3, stride=2, padding=1)
 
     in_ch = 64
@@ -108,8 +111,8 @@ def apply(params: Dict, batch_stats: Dict, x: jax.Array, *, train: bool,
             identity = x
             y = conv2d(x, blk["conv1"]["kernel"].astype(cd),
                        stride=blk_stride, padding=1)
-            y = _bn_apply(blk["bn1"], bst["bn1"], y, train, ns, "bn1")
-            y = jax.nn.relu(y)
+            y = _bn_apply(blk["bn1"], bst["bn1"], y, train, ns, "bn1",
+                          relu=True)
             y = conv2d(y, blk["conv2"]["kernel"].astype(cd),
                        stride=1, padding=1)
             y = _bn_apply(blk["bn2"], bst["bn2"], y, train, ns, "bn2")

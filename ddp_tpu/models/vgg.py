@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import initializers as init_lib
-from ..ops.layers import (BatchNormState, batch_norm, conv2d, global_avg_pool,
+from ..ops.layers import (BatchNormState, bn_relu, conv2d, global_avg_pool,
                           linear, max_pool)
 
 NAME = "vgg"
@@ -81,11 +81,12 @@ def apply(params: Params, batch_stats: BatchStats, x: jax.Array, *,
         x = conv2d(x, conv["kernel"].astype(cd), stride=1, padding=1)
         bn = backbone[f"bn{in_idx}"]
         st = batch_stats[f"bn{in_idx}"]
-        x, new_st = batch_norm(
+        # Fused BN+ReLU: same torch semantics, hand-written VJP that reads
+        # only (x, dz) in backward (ops/layers.py:bn_relu).
+        x, new_st = bn_relu(
             x, bn["scale"], bn["bias"],
             BatchNormState(st["mean"], st["var"]), train=train)
         new_stats[f"bn{in_idx}"] = {"mean": new_st.mean, "var": new_st.var}
-        x = jax.nn.relu(x)
         in_idx += 1
     # [N,2,2,512] -> [N,512] -> [N,10]
     x = global_avg_pool(x)

@@ -1,9 +1,9 @@
-from .layers import (BatchNormState, batch_norm, conv2d, dropout,
+from .layers import (BatchNormState, batch_norm, bn_relu, conv2d, dropout,
                      global_avg_pool, linear, max_pool)
 from .losses import cross_entropy_per_example, cross_entropy_sum_count
 
 __all__ = [
-    "BatchNormState", "batch_norm", "conv2d", "dropout", "global_avg_pool",
-    "linear", "max_pool", "cross_entropy_per_example",
+    "BatchNormState", "batch_norm", "bn_relu", "conv2d", "dropout",
+    "global_avg_pool", "linear", "max_pool", "cross_entropy_per_example",
     "cross_entropy_sum_count",
 ]

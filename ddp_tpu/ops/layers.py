@@ -9,6 +9,7 @@ onto the MXU and fuse the elementwise BN/ReLU chains into them.
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -36,6 +37,28 @@ def bn_sync_axis(axis_name: Optional[str]):
         yield
     finally:
         _BN_SYNC_AXIS = prev
+
+
+# Mesh axis over which bn_relu's hand-written VJP all-reduces its scale/bias
+# cotangents.  Autodiff-generated backward gets this psum inserted by
+# shard_map's replication-transpose machinery; a custom_vjp opts out of that
+# machinery, so the gradient collective must be explicit.  Set by the
+# REPLICATED-params cores (train/step.py make_loss_and_grads); deliberately
+# NOT set by the ZeRO path (train/zero.py _make_local_grads), whose contract
+# is collective-free LOCAL gradients reduced later by psum_scatter.
+_BN_GRAD_AXIS: Optional[str] = None
+
+
+@contextlib.contextmanager
+def bn_grad_axis(axis_name: Optional[str]):
+    """Within this context, bn_relu's VJP psums dγ/dβ over ``axis_name``
+    (the DDP gradient all-reduce for the fused op's parameters)."""
+    global _BN_GRAD_AXIS
+    prev, _BN_GRAD_AXIS = _BN_GRAD_AXIS, axis_name
+    try:
+        yield
+    finally:
+        _BN_GRAD_AXIS = prev
 
 
 def conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array] = None,
@@ -99,44 +122,15 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     shard_map gives the same per-shard semantics for free.
 
     Statistics are accumulated in fp32 even when ``x`` is bf16 so the
-    mixed-precision path stays stable.
-
-    The variance is computed one-pass as ``E[x^2] - E[x]^2`` so XLA fuses
-    both channel reductions into a single read of the activation — BN is
-    bandwidth-bound on TPU and the two-pass ``mean then var`` formulation
-    reads the conv output twice (measured: one-pass is +13% whole-train-step
-    throughput for VGG/512 on v5e).  The cancellation error of the one-pass
-    form is benign here: conv-of-normalized activations keeps
-    ``E[x^2]/var`` within a few orders of magnitude, and the fp32
-    accumulation leaves ~1e-6 relative error, well inside the torch-parity
-    tolerances (tests/test_ops.py, tests/test_train_step.py golden trace).
+    mixed-precision path stays stable.  The statistics encoding (one-pass
+    per-shard variance, centered two-pass under sync) lives in
+    :func:`_bn_stats`, shared with the fused :func:`bn_relu` so the two
+    ops cannot drift.
     """
     if train:
-        xf = x.astype(jnp.float32)
-        n = jnp.asarray(x.shape[0] * x.shape[1] * x.shape[2], jnp.float32)
-        if _BN_SYNC_AXIS is None:
-            batch_mean = xf.mean(axis=(0, 1, 2))
-            batch_var = jnp.maximum(  # one-pass biased var, to normalise
-                (xf * xf).mean(axis=(0, 1, 2)) - batch_mean * batch_mean,
-                0.0)
-        else:
-            # SyncBatchNorm: statistics over the GLOBAL batch (equal shard
-            # sizes inside shard_map, so means of per-shard means are
-            # exact).  The variance here is the *centered* two-pass form,
-            # not the one-pass E[x^2]-E[x]^2 used above: under cancellation
-            # (mean^2 >> var) the one-pass form amplifies the psum's
-            # rounding ~10x more than centering does (verified against an
-            # f64 reference).  Sync-BN is opt-in, so the extra read of x is
-            # an acceptable price for the better-conditioned statistics —
-            # the same choice torch's SyncBatchNorm makes.
-            r = lax.psum(jnp.ones((), jnp.float32), _BN_SYNC_AXIS)
-            batch_mean = lax.psum(xf.mean(axis=(0, 1, 2)),
-                                  _BN_SYNC_AXIS) / r
-            d = xf - batch_mean
-            batch_var = lax.psum((d * d).mean(axis=(0, 1, 2)),
-                                 _BN_SYNC_AXIS) / r
-            n = n * r
-        unbiased = batch_var * (n / jnp.maximum(n - 1.0, 1.0))
+        batch_mean, batch_var, count = _bn_stats(x.astype(jnp.float32),
+                                                 _BN_SYNC_AXIS)
+        unbiased = batch_var * (count / max(count - 1.0, 1.0))
         new_state = BatchNormState(
             mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
             var=(1.0 - momentum) * state.var + momentum * unbiased,
@@ -148,6 +142,145 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     inv = lax.rsqrt(var + eps) * scale
     y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + bias.astype(x.dtype)
     return y, new_state
+
+
+def _bn_stats(xf: jax.Array, axis: Optional[str]):
+    """Batch statistics in fp32 — the ONE encoding of the trade-off both
+    :func:`batch_norm` and :func:`bn_relu` use: one-pass ``E[x^2]-E[x]^2``
+    per-shard (XLA fuses both channel reductions into a single read of the
+    activation — BN is bandwidth-bound on TPU; measured +13% whole-step
+    for VGG/512 on v5e vs two-pass), or the better-conditioned centered
+    two-pass form when syncing over ``axis`` (under cancellation the
+    one-pass form amplifies the psum's rounding ~10x more than centering
+    does, verified against an f64 reference — sync-BN is opt-in, so the
+    extra read of x buys the better statistics, the same choice torch's
+    SyncBatchNorm makes).  Returns (mean, biased_var, count); ``count`` is
+    the total reduced element count, always a Python float (shapes and
+    mesh axis sizes are static at trace time)."""
+    n = float(xf.shape[0] * xf.shape[1] * xf.shape[2])
+    if axis is None:
+        mean = xf.mean(axis=(0, 1, 2))
+        var = jnp.maximum((xf * xf).mean(axis=(0, 1, 2)) - mean * mean, 0.0)
+        return mean, var, n
+    r = lax.axis_size(axis)
+    mean = lax.psum(xf.mean(axis=(0, 1, 2)), axis) / r
+    d = xf - mean
+    var = lax.psum((d * d).mean(axis=(0, 1, 2)), axis) / r
+    return mean, var, n * r
+
+
+def _bn_relu_fwd_impl(eps: float, axis: Optional[str], x, scale, bias):
+    xf = x.astype(jnp.float32)
+    mean, var, count = _bn_stats(xf, axis)
+    inv = lax.rsqrt(var + eps)
+    xhat = (xf - mean) * inv
+    z = jnp.maximum(xhat * scale + bias, 0.0).astype(x.dtype)
+    unbiased = var * (count / max(count - 1.0, 1.0))
+    return z, mean, unbiased, (x, mean, inv)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _bn_relu_train(eps: float, axis: Optional[str], grad_axis: Optional[str],
+                   x, scale, bias):
+    """Fused training-mode BatchNorm+ReLU with a hand-written VJP.
+
+    Why this exists (the fp32 HBM story — BASELINE.md roofline): letting
+    autodiff thread BN and ReLU separately makes the backward read BOTH the
+    conv output ``x`` (for x̂) and the post-ReLU ``z`` (for the ReLU mask),
+    and materialise the intermediate cotangent dŷ — ~7-8 activation-sized
+    HBM passes per layer, and BN backward is pure bandwidth on TPU.  This
+    VJP recomputes the mask (``x̂·γ+β > 0``) and x̂ from ``x`` alone, so
+    the whole backward touches only ``(x, dz)``: one fused reduction pass
+    (dβ, dγ) and one fused elementwise pass (dx) — 5 passes, exact fp32
+    math (the mask recompute is bit-exact against the forward's own ŷ).
+
+    Returns ``(z, batch_mean, unbiased_var)``; the running-stats blend
+    happens outside in plain JAX so its (normally zero) cotangents stay
+    differentiable — the bwd folds them in as the exact dμ/dσ² terms.
+    """
+    z, mean, unbiased, _ = _bn_relu_fwd_impl(eps, axis, x, scale, bias)
+    return z, mean, unbiased
+
+
+def _bn_relu_fwd(eps, axis, grad_axis, x, scale, bias):
+    z, mean, unbiased, res = _bn_relu_fwd_impl(eps, axis, x, scale, bias)
+    return (z, mean, unbiased), (*res, scale, bias)
+
+
+def _bn_relu_bwd(eps, axis, grad_axis, res, cts):
+    x, mean, inv, scale, bias = res
+    ct_z, ct_mean, ct_unb = cts
+    xf = x.astype(jnp.float32)
+    n = float(xf.shape[0] * xf.shape[1] * xf.shape[2])
+    count = n if axis is None else n * lax.axis_size(axis)
+    xhat = (xf - mean) * inv
+    # ReLU mask recomputed from x — identical expression to the forward's
+    # ŷ, so the mask is bit-consistent and z is never read here.
+    dy = jnp.where(xhat * scale + bias > 0.0,
+                   ct_z.astype(jnp.float32), 0.0)
+    dbeta = dy.sum(axis=(0, 1, 2))
+    dgamma = (dy * xhat).sum(axis=(0, 1, 2))
+    # Two distinct reductions share these sums — keep them apart:
+    # 1. dx's mean-subtraction terms need the sums over the STATISTICS
+    #    batch: local for per-shard BN, psum'd over ``axis`` for sync-BN
+    #    (each shard's dx then carries the cross-shard terms the stats
+    #    psum's transpose would have produced).
+    # 2. The RETURNED dγ/dβ are the cotangents of the local objective —
+    #    psum'd over ``grad_axis`` only under a replicated-params core
+    #    (the DDP all-reduce); the ZeRO local-grads core leaves grad_axis
+    #    unset and does its own psum_scatter later, sync-BN or not (γ/β
+    #    reach the local loss only through the local normalize, so their
+    #    local cotangents contain no cross-shard terms even under sync).
+    sbeta, sgamma = dbeta, dgamma
+    if axis is not None:
+        assert grad_axis is None or grad_axis == axis, (grad_axis, axis)
+        sbeta = lax.psum(dbeta, axis)
+        sgamma = lax.psum(dgamma, axis)
+    # dx through the normalisation (biased-var form), plus the exact terms
+    # for the running-stats outputs' cotangents (zeros in training — the
+    # stats are aux outputs — so XLA folds them away).
+    dvar = ct_unb * (count / max(count - 1.0, 1.0))
+    dx = (inv * (dy * scale - (sbeta * scale) / count
+                 - xhat * ((sgamma * scale) / count))
+          + ct_mean / count + dvar * (2.0 / count) * (xf - mean))
+    if grad_axis is not None:
+        dbeta = sbeta if axis is not None else lax.psum(dbeta, grad_axis)
+        dgamma = sgamma if axis is not None else lax.psum(dgamma, grad_axis)
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+_bn_relu_train.defvjp(_bn_relu_fwd, _bn_relu_bwd)
+
+
+def bn_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
+            state: BatchNormState, *, train: bool,
+            momentum: float = 0.1, eps: float = 1e-5,
+            ) -> Tuple[jax.Array, BatchNormState]:
+    """``relu(batch_norm(x))`` as one op — semantics identical to
+    :func:`batch_norm` followed by ``jax.nn.relu`` (torch defaults, same
+    sync-BN context), with the hand-written backward of
+    :func:`_bn_relu_train` (reads only ``(x, dz)`` — the 5-activation-pass
+    minimum).  Measured step-level perf is EQUAL to the autodiff
+    composition on v5e (XLA:TPU already fuses the BN reductions into conv
+    epilogues and reaches the same pass structure — the HLO-evidenced
+    negative result in BASELINE.md); the op is kept because it makes that
+    traffic structure explicit and pins the collective semantics
+    (bn_grad_axis) the ZeRO/replicated cores rely on.  Use for
+    conv→BN→ReLU chains; use :func:`batch_norm` where no ReLU immediately
+    follows (e.g. ResNet shortcut branches)."""
+    if not train:
+        # Delegate so eval numerics stay BIT-identical to the composition
+        # (tests/test_bn_relu.py::test_eval_mode_bit_identical).
+        y, _ = batch_norm(x, scale, bias, state, train=False,
+                          momentum=momentum, eps=eps)
+        return jax.nn.relu(y), state
+    z, batch_mean, unbiased = _bn_relu_train(eps, _BN_SYNC_AXIS,
+                                             _BN_GRAD_AXIS, x, scale, bias)
+    new_state = BatchNormState(
+        mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
+        var=(1.0 - momentum) * state.var + momentum * unbiased,
+    )
+    return z, new_state
 
 
 def dropout(key: jax.Array, x: jax.Array, rate: float,

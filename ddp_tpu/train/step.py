@@ -76,8 +76,13 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
             # sync_bn: BN statistics psum'd over the global batch — the
             # SyncBatchNorm the reference leaves commented out
             # (multigpu.py:127), as an opt-in (ops/layers.py:bn_sync_axis).
-            from ..ops.layers import bn_sync_axis
-            with bn_sync_axis(DATA_AXIS if sync_bn else None):
+            # bn_grad_axis: this is the REPLICATED-params core, so the
+            # fused bn_relu VJP must all-reduce its scale/bias cotangents
+            # itself (custom_vjp opts out of shard_map's transpose psum);
+            # the ZeRO local-grads core deliberately leaves it unset.
+            from ..ops.layers import bn_grad_axis, bn_sync_axis
+            with bn_sync_axis(DATA_AXIS if sync_bn else None), \
+                    bn_grad_axis(DATA_AXIS):
                 logits, new_stats = model.apply(
                     params, batch_stats,
                     _as_input(images, compute_dtype), train=True,
