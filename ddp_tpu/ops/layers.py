@@ -131,10 +131,8 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
         batch_mean, batch_var, count = _bn_stats(x.astype(jnp.float32),
                                                  _BN_SYNC_AXIS)
         unbiased = batch_var * (count / max(count - 1.0, 1.0))
-        new_state = BatchNormState(
-            mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
-            var=(1.0 - momentum) * state.var + momentum * unbiased,
-        )
+        new_state = _blend_running_stats(state, batch_mean, unbiased,
+                                         momentum)
         mean, var = batch_mean, batch_var
     else:
         new_state = state
@@ -142,6 +140,17 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     inv = lax.rsqrt(var + eps) * scale
     y = (x - mean.astype(x.dtype)) * inv.astype(x.dtype) + bias.astype(x.dtype)
     return y, new_state
+
+
+def _blend_running_stats(state: BatchNormState, batch_mean, unbiased_var,
+                         momentum: float) -> BatchNormState:
+    """The torch running-buffer EMA (momentum 0.1 default) — one encoding
+    shared by :func:`batch_norm` and :func:`bn_relu` so the fused and
+    unfused ops' checkpointed BN buffers cannot drift."""
+    return BatchNormState(
+        mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
+        var=(1.0 - momentum) * state.var + momentum * unbiased_var,
+    )
 
 
 def _bn_stats(xf: jax.Array, axis: Optional[str]):
@@ -276,11 +285,7 @@ def bn_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
         return jax.nn.relu(y), state
     z, batch_mean, unbiased = _bn_relu_train(eps, _BN_SYNC_AXIS,
                                              _BN_GRAD_AXIS, x, scale, bias)
-    new_state = BatchNormState(
-        mean=(1.0 - momentum) * state.mean + momentum * batch_mean,
-        var=(1.0 - momentum) * state.var + momentum * unbiased,
-    )
-    return z, new_state
+    return z, _blend_running_stats(state, batch_mean, unbiased, momentum)
 
 
 def dropout(key: jax.Array, x: jax.Array, rate: float,

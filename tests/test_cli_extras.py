@@ -77,6 +77,7 @@ def test_graft_entry_hooks():
     ge.dryrun_multichip(8)
 
 
+@pytest.mark.extended  # CLI all-flags composition incl. resume; default reprs: test_cli_end_to_end + test_resident_cli_end_to_end + test_zero_resident_accum_all_composed
 def test_composed_strategy_flags_cli(tmp_path, capsys, monkeypatch):
     """--resident --grad_accum --shard_update --sync_bn together through the
     real CLI (the fully-composed execution strategy), including resume:

@@ -9,6 +9,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_tpu.data import TrainLoader, synthetic
 from ddp_tpu.models import get_model
@@ -58,8 +59,15 @@ def test_accum_of_one_equals_plain_step():
     np.testing.assert_allclose(float(l_acc), float(l_plain), rtol=1e-6)
     for a, b_ in zip(jax.tree_util.tree_leaves(s_plain.params),
                      jax.tree_util.tree_leaves(s_acc.params)):
+        # atol 5e-7 (was 1e-7): the plain and scanned programs compile
+        # separately, and XLA may tile the bn_relu VJP's channel
+        # reductions differently inside a scan body than inline —
+        # measured up to 2e-7 abs on a handful of conv-kernel entries
+        # after 2 steps.  Same math, different reduction order; anything
+        # semantic (a missed rng fold, stats chaining) shows up orders of
+        # magnitude larger (see the DeepNN note above: 4.5e-4).
         np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
-                                   rtol=1e-6, atol=1e-7)
+                                   rtol=1e-6, atol=5e-7)
 
 
 def test_accum_matches_hand_composition():
@@ -144,6 +152,7 @@ def test_trainer_grad_accum_end_to_end():
     assert all(np.isfinite(l) for l in tr.loss_history)
 
 
+@pytest.mark.extended  # accum x augment; default reprs: test_resident_matches_streaming_device_augment + test_device_augment.py unit tests
 def test_accum_with_device_augment():
     """grad_accum composes with on-device augmentation: finite losses,
     correct optimizer-step count, and a trajectory distinct from the

@@ -18,6 +18,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_tpu.data import EvalLoader, ResidentData, TrainLoader, synthetic
 from ddp_tpu.models import get_model
@@ -103,6 +104,7 @@ def test_resident_single_replica_ragged():
     _assert_same_training(a, b)
 
 
+@pytest.mark.extended  # resident x accum; default reprs: test_resident_matches_streaming + test_accum_matches_hand_composition + test_zero_resident_accum_all_composed
 def test_resident_grad_accum_matches_streaming():
     """--resident composed with --grad_accum: the grouped epoch scan must
     reproduce the streaming accumulation path — full groups of A, the
@@ -118,6 +120,7 @@ def test_resident_grad_accum_matches_streaming():
     _assert_same_training(a, b)
 
 
+@pytest.mark.extended  # resident x accum x augment; default reprs: test_resident_matches_streaming_device_augment + test_zero_resident_accum_all_composed
 def test_resident_grad_accum_device_augment():
     """The composed path folds the same per-micro augmentation RNG as the
     streaming accumulation step."""

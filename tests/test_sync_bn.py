@@ -13,6 +13,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_tpu.data import synthetic
 from ddp_tpu.models import get_model
@@ -81,6 +82,7 @@ def test_unsynced_bn_differs_across_sharding():
     assert abs(l8[1] - l1[1]) > 1e-4, (l1, l8)
 
 
+@pytest.mark.extended  # sync_bn x resident; default reprs: sync_bn streaming tests here + test_resident_matches_streaming
 def test_sync_bn_resident_matches_streaming():
     """sync_bn composes with the resident scan-per-epoch path: same core
     (make_group_step) => same trajectory as streaming sync-BN."""

@@ -296,6 +296,7 @@ def test_accuracy_parity_artifact():
 
 
 @pytest.mark.slow
+@pytest.mark.extended  # torch lockstep at the exact recipe; default repr: test_golden_trace_recorded_artifact (same config, recorded pin)
 def test_golden_trace_exact_recipe_prefix():
     """Parity at the EXACT reference recipe config (VERDICT #9): batch 512,
     base_lr 0.4, steps_per_epoch 98, the 20-epoch triangle
@@ -316,6 +317,7 @@ def test_golden_trace_exact_recipe_prefix():
         np.testing.assert_allclose(g, w, atol=3e-4, err_msg=str(pw))
 
 
+@pytest.mark.extended  # long-horizon torch lockstep; default reprs: test_golden_trace_recorded_artifact (torch-free exact-recipe pin) + test_accuracy_parity_artifact (full 20-epoch endpoint)
 @pytest.mark.slow
 def test_golden_trace_two_epochs_scaled_recipe():
     """Long-horizon parity (VERDICT #9): TWO full epochs (24 optimizer

@@ -10,6 +10,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from ddp_tpu.data import TrainLoader, synthetic
 from ddp_tpu.models import get_model
@@ -113,6 +114,7 @@ def test_zero_sync_bn_matches_replicated():
                         jax.device_get(b.state.batch_stats))
 
 
+@pytest.mark.extended  # zero x accum; default repr: test_zero_resident_accum_all_composed (supersets this combination)
 def test_zero_grad_accum_matches_replicated_accum():
     """shard_update + grad_accum: scanned accumulation then one
     reduce-scatter/update/all-gather == replicated accumulation."""
