@@ -86,8 +86,94 @@ def device_op_summary(trace_dir: str, steps: int = 1,
     return out
 
 
-def print_summary(trace_dir: str, steps: int = 1, top: int = 20) -> None:
+# Op-name → phase rules for categorize().  Order matters: first match wins.
+# Derived from reading the optimized HLO of the VGG train step on v5e
+# (BASELINE.md "fp32 kernel-level attack"): conv work appears as
+# %convolution OR as kOutput fusions carrying a
+# ``convolution_algorithm_config`` — multiply_reduce_fusion (dgrad conv +
+# fused dγ/dβ epilogue), multiply_subtract_fusion (wgrad conv fused with
+# the SGD update), and (XLA names these inconsistently) plain
+# ``fusion.N`` (the forward convs + their BN-stats epilogues land here),
+# which ONLY an HLO dump can disambiguate from elementwise fusions —
+# hence conv_ops below.  Max-pool backward is select-and-scatter;
+# copy/slice-start are async DMA.
+_CATEGORY_RULES = (
+    ("conv dgrad (+BN-bwd epilogue)", ("multiply_reduce_fusion",)),
+    ("conv wgrad (+SGD update)", ("multiply_subtract_fusion",)),
+    ("convolution (unfused)", ("convolution",)),
+    ("pool backward", ("select_and_scatter", "select-and-scatter")),
+    ("pool / reduce-window", ("reduce_window", "reduce-window")),
+    ("collectives", ("all-reduce", "all-gather", "reduce-scatter",
+                     "all-to-all", "collective-permute")),
+    ("async copies/DMA", ("copy-start", "copy-done", "slice-start",
+                          "slice-done", "dynamic-update-slice-start")),
+    ("layout copies / bitcasts", ("copy", "bitcast", "transpose")),
+    ("elementwise/reduction fusions", ("fusion",)),
+)
+
+
+def conv_fusions_from_hlo(hlo_text: str) -> Dict[str, str]:
+    """Map fusion-op names that are really CONVOLUTIONS to a conv
+    sub-kind.  The discriminator is ``convolution_algorithm_config`` in
+    the backend_config — present exactly on conv emitters (a bare
+    ``window_config`` appears on many unrelated TPU ops, including
+    copies, and over-matches).  Feed the text from
+    ``jitted.lower(...).compile().as_text()`` of the SAME program the
+    trace captured — trace op names alone cannot distinguish a kOutput
+    conv fusion named ``fusion.164`` from an elementwise one."""
+    import re
+    out: Dict[str, str] = {}
+    for m in re.finditer(
+            r"%(\S+) = [^\n]*convolution_algorithm_config", hlo_text):
+        name = m.group(1)
+        if "multiply_reduce" in name:
+            kind = "conv dgrad (+BN-bwd epilogue)"
+        elif "multiply_subtract" in name:
+            kind = "conv wgrad (+SGD update)"
+        else:
+            kind = "conv (fused, kind per HLO)"
+        out[name] = kind
+    return out
+
+
+def categorize(ops: List[Tuple[str, float, float]],
+               conv_ops: Optional[Dict[str, str]] = None
+               ) -> List[Tuple[str, float, float]]:
+    """Fold a per-op list into phase buckets (same (name, total_ms,
+    ms_per_step) tuples, sorted by total).  ``conv_ops`` (from
+    :func:`conv_fusions_from_hlo`) reclassifies ambiguous ``fusion.N``
+    names that are conv fusions.  Unmatched ops land in 'other'."""
+    buckets: collections.Counter = collections.Counter()
+    per: collections.Counter = collections.Counter()
+    for name, tot, step_ms in ops:
+        # Trace op names can be FULL definition lines ("%fusion.2 = (...)
+        # fusion(%copy-done.57, ...)"); classify on the op's own name only
+        # or operand names pollute the buckets (a fusion consuming
+        # %copy-done.57 is not a copy).
+        bare = name.lstrip("%").split(" = ")[0].split("(")[0].strip()
+        if conv_ops and bare in conv_ops:
+            label = conv_ops[bare]
+        else:
+            low = bare.lower()
+            for label, keys in _CATEGORY_RULES:
+                if any(k in low for k in keys):
+                    break
+            else:
+                label = "other"
+        buckets[label] += tot
+        per[label] += step_ms
+    return [(label, buckets[label], per[label])
+            for label, _ in buckets.most_common()]
+
+
+def print_summary(trace_dir: str, steps: int = 1, top: int = 20,
+                  by_category: bool = False,
+                  hlo_path: Optional[str] = None) -> None:
     summary = device_op_summary(trace_dir, steps=steps)
+    conv_ops = None
+    if hlo_path:
+        with open(hlo_path) as f:
+            conv_ops = conv_fusions_from_hlo(f.read())
     for line_name, ops in summary.items():
         if not ops:
             continue
@@ -95,7 +181,8 @@ def print_summary(trace_dir: str, steps: int = 1, top: int = 20) -> None:
         print(f"--- {line_name}: {len(ops)} distinct ops, "
               f"{total_ms:.2f} ms total, {total_ms / max(steps, 1):.3f} "
               "ms/step")
-        for name, tot, per in ops[:top]:
+        rows = categorize(ops, conv_ops) if by_category else ops[:top]
+        for name, tot, per in rows:
             print(f"  {per:8.3f} ms/step  {name[:100]}")
 
 
@@ -105,8 +192,23 @@ def main() -> None:
     p.add_argument("--steps", type=int, default=1,
                    help="Steps captured in the trace (divides totals)")
     p.add_argument("--top", type=int, default=20)
+    p.add_argument("--by_category", action="store_true",
+                   help="Fold ops into phase buckets (conv fwd/dgrad/"
+                        "wgrad incl. their fused epilogues, pool, "
+                        "collectives, DMA, elementwise) instead of "
+                        "listing the top ops — the one-look roofline "
+                        "attribution")
+    p.add_argument("--hlo", default=None,
+                   help="Optimized-HLO text file (from jitted.lower()."
+                        "compile().as_text()) used to reclassify "
+                        "ambiguous fusion.N names that are really conv "
+                        "fusions — without it those land in the "
+                        "elementwise bucket.  MUST come from the same "
+                        "compiled program the trace captured: fusion "
+                        "numbering is not stable across programs")
     args = p.parse_args()
-    print_summary(args.trace_dir, steps=args.steps, top=args.top)
+    print_summary(args.trace_dir, steps=args.steps, top=args.top,
+                  by_category=args.by_category, hlo_path=args.hlo)
 
 
 if __name__ == "__main__":

@@ -44,19 +44,29 @@ import torch.nn.functional as F
 BATCH = 64
 BASE_LR = 0.05
 SPE = 12  # steps per epoch -> n_train = 768
-N_TEST = 256
-DATA_SEED = 21
-INIT_SEED = 2
-SHUFFLE_SEED = 1234
 
 
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=20)
-    p.add_argument("--out", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "golden",
-        "accuracy_parity_20epoch.json"))
+    p.add_argument("--data_seed", type=int, default=21)
+    p.add_argument("--init_seed", type=int, default=2)
+    p.add_argument("--shuffle_seed", type=int, default=1234)
+    p.add_argument("--n_test", type=int, default=256)
+    p.add_argument("--out", default=None,
+                   help="Output path; derived from the seed triple when "
+                        "omitted, so a non-default-seed recording can "
+                        "never silently overwrite the primary artifact")
     args = p.parse_args()
+    DATA_SEED, INIT_SEED = args.data_seed, args.init_seed
+    SHUFFLE_SEED, N_TEST = args.shuffle_seed, args.n_test
+    if args.out is None:
+        stem = ("accuracy_parity_20epoch" if
+                (DATA_SEED, INIT_SEED, SHUFFLE_SEED) == (21, 2, 1234) else
+                f"accuracy_parity_20epoch_seed{DATA_SEED}_{INIT_SEED}_"
+                f"{SHUFFLE_SEED}")
+        args.out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "golden", f"{stem}.json")
 
     from ddp_tpu.data import synthetic
     from ddp_tpu.models import get_model

@@ -123,3 +123,57 @@ def test_metrics_tensorboard_mirror(tmp_path):
     assert {"train/loss", "train/lr", "eval/accuracy"} <= tags
     # And the JSONL stream is unaffected by the mirror.
     assert len(open(str(tmp_path / "m.jsonl")).readlines()) == 3
+
+
+def test_profiling_categorize_uses_op_name_not_operands():
+    """Trace op 'names' can be full HLO definition lines; classification
+    must key on the op's own name — a fusion CONSUMING %copy-done.57 is
+    not a copy, and an operand named %select_and_scatter.1 must not drag
+    an elementwise fusion into the pool bucket."""
+    from ddp_tpu.utils.profiling import categorize
+
+    ops = [
+        ("%fusion.2 = (f32[128]) fusion(%copy-done.57, "
+         "%select_and_scatter.1)", 10.0, 1.0),
+        ("%select_and_scatter.39 = f32[512] select-and-scatter(...)",
+         20.0, 2.0),
+        ("%multiply_subtract_fusion.6 = (f32[3,3,64,128]) fusion(...)",
+         30.0, 3.0),
+        ("%copy-start.12 = (f32[64]) copy-start(...)", 5.0, 0.5),
+        ("%weird_thing.1 = f32[] custom-call()", 1.0, 0.1),
+    ]
+    got = dict((label, per) for label, _, per in categorize(ops))
+    assert got["elementwise/reduction fusions"] == 1.0
+    assert got["pool backward"] == 2.0
+    assert got["conv wgrad (+SGD update)"] == 3.0
+    assert got["async copies/DMA"] == 0.5
+    assert got["other"] == 0.1
+
+
+def test_profiling_hlo_conv_reclassification():
+    """fusion.N names that carry a conv window_config in the (same
+    program's) HLO dump are reclassified as conv work."""
+    from ddp_tpu.utils.profiling import categorize, conv_fusions_from_hlo
+
+    hlo = (
+        '%fusion.164 = (f32[64], f32[512,32,32,64]) fusion(...), '
+        'backend_config={"window_config":{},'
+        '"convolution_algorithm_config":{"emitter":"X"}}\n'
+        '%multiply_reduce_fusion.2 = (f32[64]) fusion(...), '
+        'backend_config={"convolution_algorithm_config":{}}\n'
+        # window_config WITHOUT convolution_algorithm_config appears on
+        # non-conv TPU ops (copies) and must NOT classify as conv:
+        '%copy.156 = f32[64] copy(...), '
+        'backend_config={"window_config":{}}\n'
+        '%fusion.7 = f32[128] fusion(...), backend_config={}\n'
+    )
+    conv_ops = conv_fusions_from_hlo(hlo)
+    assert conv_ops == {
+        "fusion.164": "conv (fused, kind per HLO)",
+        "multiply_reduce_fusion.2": "conv dgrad (+BN-bwd epilogue)",
+    }
+    ops = [("%fusion.164 = (...) fusion(...)", 4.0, 0.4),
+           ("%fusion.7 = f32[128] fusion(...)", 2.0, 0.2)]
+    got = dict((label, per) for label, _, per in categorize(ops, conv_ops))
+    assert got["conv (fused, kind per HLO)"] == 0.4
+    assert got["elementwise/reduction fusions"] == 0.2
