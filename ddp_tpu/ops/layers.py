@@ -193,15 +193,16 @@ def _bn_relu_train(eps: float, axis: Optional[str], grad_axis: Optional[str],
                    x, scale, bias):
     """Fused training-mode BatchNorm+ReLU with a hand-written VJP.
 
-    Why this exists (the fp32 HBM story — BASELINE.md roofline): letting
-    autodiff thread BN and ReLU separately makes the backward read BOTH the
-    conv output ``x`` (for x̂) and the post-ReLU ``z`` (for the ReLU mask),
-    and materialise the intermediate cotangent dŷ — ~7-8 activation-sized
-    HBM passes per layer, and BN backward is pure bandwidth on TPU.  This
-    VJP recomputes the mask (``x̂·γ+β > 0``) and x̂ from ``x`` alone, so
-    the whole backward touches only ``(x, dz)``: one fused reduction pass
-    (dβ, dγ) and one fused elementwise pass (dx) — 5 passes, exact fp32
-    math (the mask recompute is bit-exact against the forward's own ŷ).
+    The VJP recomputes the ReLU mask (``x̂·γ+β > 0``) and x̂ from ``x``
+    alone, so the whole backward touches only ``(x, dz)``: one fused
+    reduction pass (dβ, dγ) and one fused elementwise pass (dx) — the
+    5-activation-pass minimum, exact fp32 math (the mask recompute is
+    bit-exact against the forward's own ŷ).  NB the hypothesis that
+    autodiff needed ~7-8 passes here (reading ``z`` for the mask and
+    materialising dŷ) was MEASURED FALSE on v5e: XLA:TPU reaches the same
+    structure by fusing the reductions into the conv epilogues, so this
+    op is perf-neutral and kept for the explicit structure + collective
+    semantics (BASELINE.md "fp32 kernel-level attack").
 
     Returns ``(z, batch_mean, unbiased_var)``; the running-stats blend
     happens outside in plain JAX so its (normally zero) cotangents stay
