@@ -61,6 +61,11 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Use a synthetic dataset (no CIFAR files needed)")
     p.add_argument("--synthetic_size", default=2048, type=int,
                    help="Training-set size for --synthetic (default 2048)")
+    p.add_argument("--synthetic_label_noise", default=0.0, type=float,
+                   help="Relabel this fraction of --synthetic examples "
+                        "(train and test) uniformly at random, putting "
+                        "held-out accuracy in a non-saturated regime "
+                        "(Bayes ceiling = 1 - 0.9*p) for acceptance runs")
     p.add_argument("--bf16", action="store_true",
                    help="bfloat16 compute (BASELINE.json config #4)")
     p.add_argument("--resume", action="store_true",
@@ -287,7 +292,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     if args.synthetic:
         train_ds, test_ds = cifar10.synthetic(
             n_train=args.synthetic_size,
-            n_test=max(args.synthetic_size // 4, 64))
+            n_test=max(args.synthetic_size // 4, 64),
+            label_noise=args.synthetic_label_noise)
     else:
         train_ds, test_ds = cifar10.load(args.data_root)
 

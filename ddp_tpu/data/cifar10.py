@@ -109,17 +109,36 @@ def load(root: str = DEFAULT_ROOT,
 
 
 def synthetic(n_train: int = 2048, n_test: int = 512,
-              seed: int = 0) -> Tuple[Dataset, Dataset]:
+              seed: int = 0,
+              label_noise: float = 0.0) -> Tuple[Dataset, Dataset]:
     """Deterministic fake CIFAR with a learnable signal: the label is
     encoded in each image's mean brightness, so a real model trained on it
-    shows a decreasing loss (needed for end-to-end tests, SURVEY.md §4)."""
+    shows a decreasing loss (needed for end-to-end tests, SURVEY.md §4).
+
+    ``label_noise`` relabels that fraction of examples (train AND test)
+    uniformly at random, so accuracy-parity recordings can target a
+    NON-saturated regime — at 100% vs 100% a real framework difference
+    would be invisible, while at an intermediate accuracy the comparison
+    discriminates (analytic ceiling ``1 - 0.9*p``).
+    """
     rng = np.random.default_rng(seed)
+    # Flips come from an INDEPENDENT stream so the images and underlying
+    # clean labels of BOTH splits are bit-identical across label_noise
+    # settings — which makes the empirical ceiling of a noisy dataset
+    # computable as agreement with the label_noise=0 counterpart.
+    noise_rng = np.random.default_rng([seed, 0x5EED_10])
 
     def make(n: int) -> Dataset:
         labels = rng.integers(0, NUM_CLASSES, n).astype(np.int32)
         base = rng.integers(0, 64, (n, 32, 32, 3))
         imgs = np.clip(base + (labels * 18)[:, None, None, None],
                        0, 255).astype(np.uint8)
+        if label_noise > 0.0:
+            flip = noise_rng.random(n) < label_noise
+            labels = np.where(
+                flip,
+                noise_rng.integers(0, NUM_CLASSES, n).astype(np.int32),
+                labels)
         return Dataset(imgs, labels)
 
     return make(n_train), make(n_test)

@@ -53,6 +53,12 @@ def main() -> None:
     p.add_argument("--init_seed", type=int, default=2)
     p.add_argument("--shuffle_seed", type=int, default=1234)
     p.add_argument("--n_test", type=int, default=256)
+    p.add_argument("--label_noise", type=float, default=0.0,
+                   help="Fraction of examples (train and test) relabeled "
+                        "uniformly at random. Non-zero puts the recording "
+                        "in a NON-saturated accuracy regime (ceiling = "
+                        "1 - 0.9*p), where a framework difference could "
+                        "not hide behind 100%%-vs-100%%.")
     p.add_argument("--out", default=None,
                    help="Output path; derived from the seed triple when "
                         "omitted, so a non-default-seed recording can "
@@ -65,6 +71,8 @@ def main() -> None:
                 (DATA_SEED, INIT_SEED, SHUFFLE_SEED) == (21, 2, 1234) else
                 f"accuracy_parity_20epoch_seed{DATA_SEED}_{INIT_SEED}_"
                 f"{SHUFFLE_SEED}")
+        if args.label_noise > 0.0:
+            stem += f"_noise{args.label_noise:g}"
         args.out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "golden", f"{stem}.json")
 
@@ -84,7 +92,14 @@ def main() -> None:
         tmodel.state_dict())
 
     train_ds, test_ds = synthetic(n_train=SPE * BATCH, n_test=N_TEST,
-                                  seed=DATA_SEED)
+                                  seed=DATA_SEED,
+                                  label_noise=args.label_noise)
+    empirical_ceiling = 100.0
+    if args.label_noise > 0.0:
+        clean_test = synthetic(n_train=SPE * BATCH, n_test=N_TEST,
+                               seed=DATA_SEED)[1]
+        empirical_ceiling = float(
+            (test_ds.labels == clean_test.labels).mean() * 100.0)
     x_all = train_ds.images.astype(np.float32) / 255.0
     y_all = train_ds.labels
     x_test = test_ds.images.astype(np.float32) / 255.0
@@ -153,7 +168,12 @@ def main() -> None:
             "steps_per_epoch": SPE, "epochs": args.epochs,
             "n_train": SPE * BATCH, "n_test": N_TEST,
             "init": f"torch.manual_seed({INIT_SEED}) TorchVGG state_dict",
-            "data": f"ddp_tpu.data.synthetic(seed={DATA_SEED})",
+            "data": f"ddp_tpu.data.synthetic(seed={DATA_SEED}, "
+                    f"label_noise={args.label_noise})",
+            "label_noise": args.label_noise,
+            "bayes_accuracy_ceiling_pct":
+                round(100.0 * (1.0 - 0.9 * args.label_noise), 2),
+            "empirical_ceiling_pct": round(empirical_ceiling, 4),
             "shuffle": f"np.default_rng({SHUFFLE_SEED}+epoch).permutation, "
                        "identical both sides",
             "recipe": "reference 20-epoch triangle at the linearly-scaled "

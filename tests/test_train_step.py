@@ -265,8 +265,9 @@ def test_accuracy_parity_artifact():
     minutes — recorded offline, validated here).
 
     What the recordings show (and this test pins, for EVERY committed
-    seed — two independent (data, init, shuffle) seed triples as of round
-    3): per-epoch mean losses agree to <1.5% over the first two epochs
+    seed — three independent (data, init, shuffle) seed triples plus the
+    label-noise non-saturated recordings as of round 3): per-epoch mean
+    losses agree to <1.5% over the first two epochs
     (the lockstep horizon every seed sustains — 24 optimizer steps);
     mid-run trajectories diverge chaotically (momentum amplifies
     float drift at this tiny-data recipe — max epoch-mean delta ~0.5-0.6,
@@ -291,13 +292,15 @@ def test_accuracy_parity_artifact():
         cfg = art["config"]
         assert cfg["epochs"] == 20 and cfg["model"] == "vgg", path
         assert cfg["batch"] == 64 and cfg["base_lr"] == 0.05, path
+        noise = cfg.get("label_noise", 0.0)
         # The artifacts must be genuinely distinct recordings: extract
         # the (data, init, shuffle) triple from the provenance strings
         # and require uniqueness (catches a non-default-seed run that
         # overwrote another artifact's file).
         triple = (re.search(r"seed=(\d+)", cfg["data"]).group(1),
                   re.search(r"manual_seed\((\d+)\)", cfg["init"]).group(1),
-                  re.search(r"rng\((\d+)", cfg["shuffle"]).group(1))
+                  re.search(r"rng\((\d+)", cfg["shuffle"]).group(1),
+                  noise)
         assert triple not in seed_triples, (path, triple)
         seed_triples.append(triple)
         pe = art["per_epoch"]
@@ -309,14 +312,29 @@ def test_accuracy_parity_artifact():
         for r in pe[:2]:
             assert (abs(r["jax_mean_loss"] - r["torch_mean_loss"])
                     / abs(r["torch_mean_loss"]) < 0.015), (path, r)
-        # Endpoint: both sides fully learn the held-out split (chance =
-        # 10%) — at every seed.
-        assert art["final_jax_acc"] == 100.0, path
-        assert art["final_torch_acc"] == 100.0, path
-        assert abs(art["final_acc_delta"]) <= 1e-9, path
-        for r in pe[-3:]:
-            assert r["jax_acc"] == 100.0 and r["torch_acc"] >= 96.0, (
-                path, r)
+        if noise == 0.0:
+            # Endpoint: both sides fully learn the held-out split (chance
+            # = 10%) — at every seed.
+            assert art["final_jax_acc"] == 100.0, path
+            assert art["final_torch_acc"] == 100.0, path
+            assert abs(art["final_acc_delta"]) <= 1e-9, path
+            for r in pe[-3:]:
+                assert r["jax_acc"] == 100.0 and r["torch_acc"] >= 96.0, (
+                    path, r)
+        else:
+            # NON-saturated regime (label_noise > 0): the held-out
+            # ceiling is the fraction of test labels that survived the
+            # flip (empirical_ceiling_pct < 100), so a framework defect
+            # cannot hide behind saturation.  Both sides must end within
+            # 2 pp of the empirical ceiling and within 1 pp of each
+            # other (the recorded artifacts sit EXACTLY on the ceiling
+            # with delta 0.0 for the final four epochs; slack covers
+            # future re-recordings in this chaotic-divergence regime).
+            ceil = cfg["empirical_ceiling_pct"]
+            assert ceil < 100.0, path
+            for side in ("final_jax_acc", "final_torch_acc"):
+                assert ceil - 2.0 <= art[side] <= ceil + 0.5, (path, side)
+            assert abs(art["final_acc_delta"]) <= 1.0, path
 
 
 @pytest.mark.slow
