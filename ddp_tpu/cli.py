@@ -74,6 +74,13 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Checkpoint path (reference: checkpoint.pt)")
     p.add_argument("--lr", default=0.4, type=float,
                    help="Peak learning rate (reference: 0.4)")
+    p.add_argument("--momentum", default=0.9, type=float,
+                   help="SGD momentum (reference hardcodes 0.9, "
+                        "multigpu.py:132)")
+    p.add_argument("--weight_decay", default=5e-4, type=float,
+                   help="SGD weight decay, applied to ALL params incl. BN "
+                        "like the reference (hardcoded 5e-4, "
+                        "multigpu.py:133)")
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size override (default: entry-point specific)")
@@ -341,7 +348,10 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         tensorboard_dir=(args.tensorboard_dir
                          if jax.process_index() == 0 else None))
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
-                      lr_schedule=lr_schedule, sgd_config=SGDConfig(lr=args.lr),
+                      lr_schedule=lr_schedule,
+                      sgd_config=SGDConfig(lr=args.lr,
+                                           momentum=args.momentum,
+                                           weight_decay=args.weight_decay),
                       save_every=args.save_every,
                       snapshot_path=args.snapshot_path,
                       compute_dtype=compute_dtype, seed=args.seed,
