@@ -323,3 +323,20 @@ def test_momentum_weight_decay_flags_reach_sgd_config(monkeypatch):
 
     d = cli.build_parser("t").parse_args(["1", "1"])
     assert d.momentum == 0.9 and d.weight_decay == 5e-4
+
+
+def test_conv_probe_flops_and_shapes():
+    """conv_probe's FLOP accounting and shape table stay consistent with
+    the VGG architecture (the BASELINE.md emitter analysis rests on
+    them): 8 convs total, spatial sizes halving at each pool, and the
+    summed fwd FLOPs matching the known ~1.2 GFLOP/sample VGG forward
+    at batch 1."""
+    from ddp_tpu.ops.conv_probe import VGG_CONV_SHAPES, conv_flops
+
+    assert sum(reps for *_s, reps in VGG_CONV_SHAPES) == 8
+    fwd = sum(conv_flops(1, h, cin, cout) * reps
+              for h, cin, cout, reps in VGG_CONV_SHAPES)
+    # 3.6 GFLOP/sample trained (BASELINE.md roofline) = 3x forward.
+    assert 1.0e9 < fwd < 1.4e9, fwd
+    # Spatial sizes follow the pool structure of VGG.ARCH.
+    assert [h for h, *_ in VGG_CONV_SHAPES] == [32, 32, 16, 16, 8, 8, 4]
