@@ -328,8 +328,12 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     # reference's hardcoded 98/49 and 20 (SURVEY.md appendix).  Under
     # gradient accumulation the schedule counts OPTIMIZER steps (one per
     # group of --grad_accum micro-batches), matching torch's
-    # scheduler.step()-after-optimizer.step() convention.
-    opt_steps = -(-len(train_loader) // max(args.grad_accum, 1))
+    # scheduler.step()-after-optimizer.step() convention.  The count comes
+    # from the loader's knowledge of its own accumulation grouping (the
+    # ragged tail is always its own optimizer step) — ceil(len/A) would
+    # undercount by one whenever the full-batch count isn't divisible by A,
+    # clipping the LR triangle early.
+    opt_steps = train_loader.optimizer_steps_per_epoch(args.grad_accum)
     lr_schedule = build_schedule(args, opt_steps)
 
     if args.tensorboard_dir:

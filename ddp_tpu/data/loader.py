@@ -70,6 +70,22 @@ class TrainLoader:
     def __len__(self) -> int:
         return self.steps_per_epoch
 
+    def optimizer_steps_per_epoch(self, grad_accum: int = 1) -> int:
+        """How many optimizer steps one epoch actually takes under
+        ``--grad_accum``.  The accumulation grouping (``Trainer``'s
+        ``_stack_groups`` and the resident splitter) flushes the current
+        partial group when the ragged final batch arrives — the tail is
+        always its own optimizer step — so the count is
+        ``ceil(n_full / A) + (1 if ragged else 0)``, which exceeds
+        ``ceil(len(loader) / A)`` whenever the number of FULL batches
+        isn't divisible by A.  The LR schedule counts optimizer steps
+        (torch's scheduler.step()-after-optimizer.step() convention,
+        /root/reference/singlegpu.py:108), so it must be built from this
+        number, not from the batch count."""
+        a = max(grad_accum, 1)
+        n_full, rem = divmod(len(self.samplers[0]), self.per_replica_batch)
+        return -(-n_full // a) + (1 if rem else 0)
+
     def _epoch_shards(self):
         if getattr(self, "_shards", None) is None:
             self._shards = [s.indices() for s in self.samplers]
