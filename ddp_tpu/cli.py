@@ -302,6 +302,15 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
             n_test=max(args.synthetic_size // 4, 64),
             label_noise=args.synthetic_label_noise)
     else:
+        if args.synthetic_label_noise > 0:
+            # Refuse rather than silently train on clean real data: the
+            # noise knob only exists for the synthetic acceptance regime,
+            # and a run that LOOKS noised but isn't would corrupt any
+            # parity comparison made with it.
+            raise SystemExit(
+                "--synthetic_label_noise only applies to the --synthetic "
+                "dataset; it would be silently ignored for real CIFAR-10. "
+                "Pass --synthetic, or drop the flag.")
         train_ds, test_ds = cifar10.load(args.data_root)
 
     model = get_model(args.model)

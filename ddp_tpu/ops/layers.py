@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -19,46 +20,59 @@ from jax import lax
 # NHWC / HWIO are the layouts XLA:TPU convolutions are natively tiled for.
 CONV_DIMS = ("NHWC", "HWIO", "NHWC")
 
-# Ambient mesh axis over which batch_norm synchronises its batch statistics
-# (the TPU-native SyncBatchNorm the reference keeps commented out,
-# multigpu.py:127).  A trace-time context rather than a per-call argument so
-# model code stays signature-identical whether BN is synced or not; the
-# step builders (train/step.py) set it from their sync_bn flag.
-_BN_SYNC_AXIS: Optional[str] = None
+# Ambient trace-time BN context, THREAD-LOCAL so concurrent traces (async
+# compiles, threaded tests) can each set their own axes without
+# cross-contamination.  Two fields:
+#
+# ``sync_axis`` — mesh axis over which batch_norm synchronises its batch
+# statistics (the TPU-native SyncBatchNorm the reference keeps commented
+# out, multigpu.py:127).  A trace-time context rather than a per-call
+# argument so model code stays signature-identical whether BN is synced or
+# not; the step builders (train/step.py) set it from their sync_bn flag.
+#
+# ``grad_axis`` — mesh axis over which bn_relu's hand-written VJP
+# all-reduces its scale/bias cotangents.  Autodiff-generated backward gets
+# this psum inserted by shard_map's replication-transpose machinery; a
+# custom_vjp opts out of that machinery, so the gradient collective must be
+# explicit.  Set by the REPLICATED-params cores (train/step.py
+# make_loss_and_grads); deliberately NOT set by the ZeRO path
+# (train/zero.py _make_local_grads), whose contract is collective-free
+# LOCAL gradients reduced later by psum_scatter.
+_BN_CTX = threading.local()
+
+
+def _bn_sync_axis() -> Optional[str]:
+    return getattr(_BN_CTX, "sync_axis", None)
+
+
+def _bn_grad_axis() -> Optional[str]:
+    return getattr(_BN_CTX, "grad_axis", None)
 
 
 @contextlib.contextmanager
 def bn_sync_axis(axis_name: Optional[str]):
-    """Within this context, training-mode batch_norm psums its statistics
-    over ``axis_name`` (must be inside shard_map over that axis)."""
-    global _BN_SYNC_AXIS
-    prev, _BN_SYNC_AXIS = _BN_SYNC_AXIS, axis_name
+    """Within this context (and thread), training-mode batch_norm psums its
+    statistics over ``axis_name`` (must be inside shard_map over that
+    axis)."""
+    prev = _bn_sync_axis()
+    _BN_CTX.sync_axis = axis_name
     try:
         yield
     finally:
-        _BN_SYNC_AXIS = prev
-
-
-# Mesh axis over which bn_relu's hand-written VJP all-reduces its scale/bias
-# cotangents.  Autodiff-generated backward gets this psum inserted by
-# shard_map's replication-transpose machinery; a custom_vjp opts out of that
-# machinery, so the gradient collective must be explicit.  Set by the
-# REPLICATED-params cores (train/step.py make_loss_and_grads); deliberately
-# NOT set by the ZeRO path (train/zero.py _make_local_grads), whose contract
-# is collective-free LOCAL gradients reduced later by psum_scatter.
-_BN_GRAD_AXIS: Optional[str] = None
+        _BN_CTX.sync_axis = prev
 
 
 @contextlib.contextmanager
 def bn_grad_axis(axis_name: Optional[str]):
-    """Within this context, bn_relu's VJP psums dγ/dβ over ``axis_name``
-    (the DDP gradient all-reduce for the fused op's parameters)."""
-    global _BN_GRAD_AXIS
-    prev, _BN_GRAD_AXIS = _BN_GRAD_AXIS, axis_name
+    """Within this context (and thread), bn_relu's VJP psums dγ/dβ over
+    ``axis_name`` (the DDP gradient all-reduce for the fused op's
+    parameters)."""
+    prev = _bn_grad_axis()
+    _BN_CTX.grad_axis = axis_name
     try:
         yield
     finally:
-        _BN_GRAD_AXIS = prev
+        _BN_CTX.grad_axis = prev
 
 
 def conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array] = None,
@@ -129,7 +143,7 @@ def batch_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
     """
     if train:
         batch_mean, batch_var, count = _bn_stats(x.astype(jnp.float32),
-                                                 _BN_SYNC_AXIS)
+                                                 _bn_sync_axis())
         unbiased = batch_var * (count / max(count - 1.0, 1.0))
         new_state = _blend_running_stats(state, batch_mean, unbiased,
                                          momentum)
@@ -284,8 +298,8 @@ def bn_relu(x: jax.Array, scale: jax.Array, bias: jax.Array,
         y, _ = batch_norm(x, scale, bias, state, train=False,
                           momentum=momentum, eps=eps)
         return jax.nn.relu(y), state
-    z, batch_mean, unbiased = _bn_relu_train(eps, _BN_SYNC_AXIS,
-                                             _BN_GRAD_AXIS, x, scale, bias)
+    z, batch_mean, unbiased = _bn_relu_train(eps, _bn_sync_axis(),
+                                             _bn_grad_axis(), x, scale, bias)
     return z, _blend_running_stats(state, batch_mean, unbiased, momentum)
 
 

@@ -4,8 +4,11 @@
     equal the number of optimizer steps the accumulation grouping actually
     produces (ragged tail = its own step), not ceil(len(loader)/A)
     (reference per-batch-schedule contract: singlegpu.py:108,142-149).
+#6  BN trace-time context must be thread-local: two step builders traced
+    from two threads must not see each other's sync/grad axes.
 """
 import functools
+import threading
 
 import jax
 import numpy as np
@@ -90,3 +93,83 @@ def test_ragged_accum_step_count_matches_schedule_resident():
     tr.train(1)
     assert int(tr.state.step) == spe == 3
     assert len(tr.loss_history) == 3
+
+
+def test_bn_context_is_thread_local():
+    """A thread holding bn_sync_axis/bn_grad_axis must not leak the axes
+    into other threads."""
+    from ddp_tpu.ops import layers
+    entered, release = threading.Event(), threading.Event()
+    after_exit = {}
+
+    def holder():
+        with layers.bn_sync_axis("data"), layers.bn_grad_axis("data"):
+            entered.set()
+            release.wait(10)
+        # Restore is per-thread too: read back on the HOLDER thread.
+        after_exit["ctx"] = (layers._bn_sync_axis(), layers._bn_grad_axis())
+
+    th = threading.Thread(target=holder)
+    th.start()
+    assert entered.wait(10)
+    seen = (layers._bn_sync_axis(), layers._bn_grad_axis())
+    release.set()
+    th.join(10)
+    assert seen == (None, None)
+    assert after_exit["ctx"] == (None, None)
+
+
+def test_concurrent_traces_no_bn_crosstalk():
+    """Two threads trace train-mode batch_norm concurrently — one with
+    sync-BN on, one off, both contexts guaranteed live at trace time by a
+    barrier.  Each jaxpr must reflect its OWN thread's context (a psum in
+    the synced trace only); with module-global context, one thread's axis
+    would bleed into the other's trace."""
+    from jax.sharding import PartitionSpec as P
+    from ddp_tpu.ops import layers
+    from ddp_tpu.parallel.mesh import DATA_AXIS
+
+    mesh = make_mesh(2)
+    x = np.ones((4, 4, 4, 3), np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    state = layers.BatchNormState(np.zeros(3, np.float32),
+                                  np.ones(3, np.float32))
+    barrier = threading.Barrier(2, timeout=30)
+    results, errors = {}, {}
+
+    def body(xs):
+        y, _ = layers.batch_norm(xs, scale, bias, state, train=True)
+        return y
+
+    def trace(name, axis):
+        try:
+            with layers.bn_sync_axis(axis):
+                barrier.wait()  # both contexts set before either trace
+                mapped = jax.shard_map(body, mesh=mesh,
+                                       in_specs=P(DATA_AXIS),
+                                       out_specs=P(DATA_AXIS))
+                results[name] = "psum" in str(jax.make_jaxpr(mapped)(x))
+        except Exception as e:  # pragma: no cover - surfaced below
+            errors[name] = e
+            barrier.abort()
+
+    threads = [threading.Thread(target=trace, args=("sync", DATA_AXIS)),
+               threading.Thread(target=trace, args=("plain", None))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert results == {"sync": True, "plain": False}
+
+
+def test_label_noise_without_synthetic_refuses():
+    """--synthetic_label_noise without --synthetic must error, not be
+    silently ignored (ADVICE r3)."""
+    import pytest
+    from ddp_tpu import cli
+    args = cli.build_parser("t").parse_args(
+        ["1", "1", "--synthetic_label_noise", "0.25"])
+    with pytest.raises(SystemExit, match="synthetic_label_noise"):
+        cli.run(args, num_devices=1)
