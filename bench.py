@@ -2,7 +2,10 @@
 CIFAR-shaped data, the reference's workload — singlegpu.py:134, batch 512,
 multigpu.py:259).
 
-Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"},
+plus "device_ms_per_step" and — for models with a FLOP model, on real
+accelerators — "mfu" (absolute efficiency against the measured bf16-pass
+MXU peak, so the driver tail self-interprets across rounds).
 The reference publishes no numbers (SURVEY.md §6; BASELINE.json
 "published": {}), so ``vs_baseline`` is reported against this framework's
 recorded fp32 baseline when present in BASELINE_BENCH (below), else 1.0.
@@ -60,6 +63,15 @@ from ddp_tpu.train.step import init_train_state
 # driver-parsed tail — VERDICT r2 weak #2).
 BASELINE_BENCH = 22897.0
 BASELINE_BENCH_BF16 = 30372.0
+
+# FLOP model for absolute-efficiency reporting (VERDICT r3 weak #5): VGG
+# trains at ~3.6 GFLOP/sample (fwd + dgrad + wgrad conv FLOPs; BASELINE.md
+# roofline, "1.84 TFLOP/step at batch 512").  MFU is reported against the
+# ~197 TFLOP/s bf16-pass MXU peak measured on this chip family — the right
+# denominator for BOTH precisions here, because the fp32 path's convs also
+# run as single-pass bf16-input/fp32-accum MXU passes (BASELINE.md).
+TRAIN_GFLOP_PER_SAMPLE = {"vgg": 3.6}
+PEAK_TFLOPS_BF16_PASS = 197.0
 
 
 def _parse_args():
@@ -211,7 +223,7 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         base = (None if args.shard_update
                 else BASELINE_BENCH_BF16 if bf16 else BASELINE_BENCH)
         vs = sps_chip / base if base else 1.0
-        return {
+        rec = {
             "metric": f"{args.model} train samples/sec/chip "
                       f"(batch {args.batch_size}/chip, "
                       f"{'bf16' if bf16 else 'fp32'}, {n_chips} chip(s), "
@@ -220,7 +232,15 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
             "value": round(sps_chip, 2),
             "unit": "samples/sec/chip",
             "vs_baseline": round(vs, 3),
+            # Absolute-efficiency context so the driver tail self-
+            # interprets across rounds (VERDICT r3 weak #5).
+            "device_ms_per_step": round(dt / args.steps * 1000.0, 3),
         }
+        gflop = TRAIN_GFLOP_PER_SAMPLE.get(args.model)
+        if gflop is not None and jax.default_backend() != "cpu":
+            rec["mfu"] = round(sps_chip * gflop * 1e9
+                               / (PEAK_TFLOPS_BF16_PASS * 1e12), 4)
+        return rec
 
     def step_window():
         nonlocal state

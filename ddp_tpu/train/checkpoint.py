@@ -28,6 +28,18 @@ from ..optim.sgd import SGDState
 
 _SECTIONS = ("params", "batch_stats", "momentum")
 
+# Bump when the on-disk layout changes incompatibly.  Version 1 is the
+# round-1..3 layout (section/key/subkey npz + meta/step + meta/epoch);
+# files written before the version field existed are exactly this layout,
+# so a missing field reads as 1.
+FORMAT_VERSION = 1
+
+
+class CheckpointError(ValueError):
+    """A checkpoint file that cannot be restored (torn write, foreign or
+    future-format file) — raised with the path and what was wrong instead
+    of the raw KeyError/zipfile internals."""
+
 
 class Checkpoint(NamedTuple):
     params: Dict[str, Any]
@@ -77,6 +89,7 @@ def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
         flat.update({f"{section}/{k}": v for k, v in sect_flat.items()})
     flat["meta/step"] = np.asarray(int(step), np.int64)
     flat["meta/epoch"] = np.asarray(int(epoch), np.int64)
+    flat["meta/format_version"] = np.asarray(FORMAT_VERSION, np.int64)
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
@@ -91,14 +104,43 @@ def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
 
 def load_checkpoint(path: str) -> Checkpoint:
     """Restore everything ``save_checkpoint`` wrote (the path the reference
-    never built — SURVEY.md §3.4 'resume is absent')."""
-    with np.load(path) as z:
-        flat = {k: z[k] for k in z.files}
+    never built — SURVEY.md §3.4 'resume is absent').
+
+    Raises :class:`CheckpointError` — not raw ``zipfile``/``KeyError``
+    internals — on a torn, foreign, or future-format file, naming the path
+    and the problem (resume is a headline feature; its failure mode must be
+    diagnosable).  The save path writes atomically, so a torn file here
+    means external truncation/copy damage, not a crashed save."""
+    try:
+        with np.load(path) as z:
+            flat = {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        # A missing path is not a corrupt file — keep the standard
+        # exception so callers' fall-back-to-fresh-training idiom works.
+        raise
+    except Exception as e:  # BadZipFile / OSError / pickle guard / EOF
+        raise CheckpointError(
+            f"checkpoint {path!r} is not a readable npz archive "
+            f"({type(e).__name__}: {e}); the file is torn or is not a "
+            "ddp_tpu checkpoint") from e
+    version = int(flat.get("meta/format_version", 1))
+    if version > FORMAT_VERSION:
+        raise CheckpointError(
+            f"checkpoint {path!r} has format_version {version}, newer than "
+            f"this build's {FORMAT_VERSION}; upgrade ddp_tpu to restore it")
+    missing = [k for k in ("meta/step", "meta/epoch") if k not in flat]
     sections: Dict[str, Dict[str, np.ndarray]] = {s: {} for s in _SECTIONS}
     for key, val in flat.items():
         section, _, rest = key.partition("/")
         if section in sections:
             sections[section][rest] = val
+    if missing or not sections["params"]:
+        what = (f"missing keys {missing}" if missing
+                else "no params/ entries")
+        raise CheckpointError(
+            f"checkpoint {path!r} is a valid npz but not a ddp_tpu "
+            f"checkpoint ({what}); it may be truncated or written by "
+            "another tool")
     return Checkpoint(
         params=_unflatten(sections["params"]),
         batch_stats=_unflatten(sections["batch_stats"]),
