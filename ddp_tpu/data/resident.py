@@ -76,11 +76,12 @@ class ResidentData:
                  if d.process_index == jax.process_index()]
         limit = _device_bytes_limit(local[0]) if local else None
         if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            limits = multihost_utils.process_allgather(
-                np.asarray(-1 if limit is None else limit, np.int64))
-            limit = (None if (limits < 0).any()
-                     else int(np.min(limits)))
+            # Mesh-based global min (NOT multihost_utils.process_allgather,
+            # which assumes equal per-host device counts and breaks on
+            # asymmetric topologies); "no limit reported" anywhere
+            # disables the guard everywhere.
+            from ..parallel.mesh import process_min_mib
+            limit = process_min_mib(mesh, limit)
         needed = images.nbytes + labels.nbytes
         if limit is not None and needed > HBM_BUDGET_FRACTION * limit:
             raise ValueError(
@@ -97,5 +98,9 @@ class ResidentData:
             self.images = jax.device_put(images, rep)
             self.labels = jax.device_put(labels, rep)
         else:
-            self.images = jax.make_array_from_process_local_data(rep, images)
-            self.labels = jax.make_array_from_process_local_data(rep, labels)
+            # Explicit global shapes (= local: fully replicated), so the
+            # upload works on asymmetric host->device topologies too.
+            self.images = jax.make_array_from_process_local_data(
+                rep, images, images.shape)
+            self.labels = jax.make_array_from_process_local_data(
+                rep, labels, labels.shape)

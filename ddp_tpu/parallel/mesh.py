@@ -56,6 +56,52 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def assemble_from_local(sharding: NamedSharding, v, axis: int) -> jax.Array:
+    """``jax.make_array_from_process_local_data`` with the global shape made
+    EXPLICIT along the sharded ``axis``: the library's inference assumes
+    every process contributes equal-sized blocks and fails on asymmetric
+    host->replica topologies (e.g. a 2/1/1 split of a 4-device mesh),
+    which real pods can have even though the reference's mp.spawn fan-out
+    never does (multigpu.py:262-263).  Each of this process's addressable
+    mesh devices holds the same per-replica extent, so the global extent is
+    ``local_extent / n_local * n_total``."""
+    n_local = len(sharding.addressable_devices)
+    if n_local == 0:
+        raise ValueError(
+            f"process {jax.process_index()} owns no devices of this mesh; "
+            "it cannot contribute process-local data (every participating "
+            "process must hold at least one mesh device)")
+    n_total = sharding.mesh.devices.size
+    shape = list(v.shape)
+    shape[axis] = shape[axis] // n_local * n_total
+    return jax.make_array_from_process_local_data(sharding, v, tuple(shape))
+
+
+def process_min_mib(mesh: Mesh, value_bytes: Optional[int]) -> Optional[int]:
+    """Global minimum byte count over processes, asymmetric-topology-safe;
+    ``None`` anywhere (or everywhere) means "no limit" and wins.
+
+    ``multihost_utils.process_allgather`` reshapes ``jax.devices()`` into
+    ``(process_count, local_device_count)`` and so breaks on unequal
+    per-host device counts; this instead places each process's value on its
+    own mesh devices and jit-reduces with a replicated output every process
+    can read.  The value crosses the device in MiB, not bytes: without
+    x64 enabled JAX canonicalizes int64 to int32, where real HBM byte
+    capacities (2^34...) overflow — 16 GiB wraps to exactly 0 — while MiB
+    counts stay int32-exact up to 2 TiB.  Returns floor-MiB bytes (the
+    guard's comparison tolerance is far coarser than 1 MiB)."""
+    import jax.numpy as jnp
+    mib = -1 if value_bytes is None else value_bytes // 2 ** 20
+    local = [d for d in mesh.devices.flat
+             if d.process_index == jax.process_index()]
+    vals = assemble_from_local(
+        batch_sharding(mesh),
+        np.full(max(len(local), 1), mib, np.int32), 0)
+    gmin = int(jax.jit(jnp.min,
+                       out_shardings=replicated_sharding(mesh))(vals))
+    return None if gmin < 0 else gmin * 2 ** 20
+
+
 def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
     """Per-host slice of a global batch (multi-host data feeding)."""
     if global_batch % mesh.devices.size:

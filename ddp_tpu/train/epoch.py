@@ -181,4 +181,5 @@ def put_index_matrix(idx: np.ndarray, mesh: Mesh) -> jax.Array:
     idx = np.ascontiguousarray(idx)
     if jax.process_count() == 1:
         return jax.device_put(idx, sharding)
-    return jax.make_array_from_process_local_data(sharding, idx)
+    from ..parallel.mesh import assemble_from_local  # explicit global shape
+    return assemble_from_local(sharding, idx, idx.ndim - 1)

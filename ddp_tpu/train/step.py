@@ -38,7 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
-from ..parallel.mesh import DATA_AXIS, batch_sharding, replicated_sharding
+from ..parallel.mesh import (DATA_AXIS, assemble_from_local, batch_sharding,
+                             replicated_sharding)
 
 
 def _as_input(x: jax.Array, compute_dtype=None) -> jax.Array:
@@ -345,7 +346,7 @@ def shard_batch(batch: dict, mesh: Mesh) -> dict:
     sharding = batch_sharding(mesh)
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
-    return {k: jax.make_array_from_process_local_data(sharding, v)
+    return {k: assemble_from_local(sharding, v, 0)
             for k, v in batch.items()}
 
 
@@ -355,5 +356,5 @@ def shard_batch_stacked(batch: dict, mesh: Mesh) -> dict:
     sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     if jax.process_count() == 1:
         return jax.device_put(batch, sharding)
-    return {k: jax.make_array_from_process_local_data(sharding, v)
+    return {k: assemble_from_local(sharding, v, 1)
             for k, v in batch.items()}

@@ -1,7 +1,7 @@
-"""Worker process for tests/test_multihost.py: one of two 'hosts' (4 CPU
-devices each) driving the REAL framework path — ``jax.distributed``
-rendezvous, per-host ``TrainLoader`` slice, ``make_array_from_process_local_
-data`` batch assembly, shard_map train step, process-0 checkpoint write.
+"""Worker process for tests/test_multihost.py: one of N 'hosts' driving the
+REAL framework path — ``jax.distributed`` rendezvous, per-host
+``TrainLoader`` slice, ``make_array_from_process_local_data`` batch
+assembly, shard_map train step, process-0 checkpoint write.
 
 Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path>
        [mode] [epochs] [resume]
@@ -12,6 +12,10 @@ Usage: python _mh_worker.py <process_id> <coordinator> <out_ckpt_path>
 ``put_index_matrix``'s local-column assembly across real processes), or
 ``zero`` (weight-update sharding: exercises the cross-process momentum
 shard and the collective checkpoint canonicalisation in train/zero.py).
+``streaming_eval`` / ``zero_resident_eval`` additionally evaluate after
+training (ragged 120/72 synthetic split) and print ``MH_EVAL_ACC=`` —
+driving the multi-process ``EvalLoader`` row-block (__iter__) and
+index-matrix column-slicing (epoch_index_matrix, loader.py) paths.
 ``epochs`` (default 2) is the target epoch count, and a literal ``resume``
 6th argument restores from the checkpoint first — every process reads the
 rank-0 file (the all-host restore of the replicated pytree, BASELINE.json
@@ -20,16 +24,25 @@ config #5).
 ``mode`` ``cli`` drives the full ``ddp_tpu.cli.run`` path instead (with
 ``--eval_every`` + ``--metrics_path`` = <ckpt>.metrics.jsonl) — used to
 assert periodic-eval prints/records are rank-0-gated across real processes.
+
+Topology comes from the spawning test: ``MH_NUM_PROCESSES`` processes and
+``MH_LOCAL_DEVICES`` devices per process — either one count shared by all
+(2 hosts x 4, or 4 x 2 for rank >= 2 assembly) or a comma list of
+PER-PROCESS counts (``2,1,1``: the reference's N-rank fan-out never has
+unequal ranks, but real TPU pods can — asymmetric host->replica blocks,
+VERDICT r3 #3).  The global mesh is all devices, so every topology
+checkpoints identically to the single-process run.
 """
 import os
 import sys
 
-# Topology from the spawning test (default: the original 2 hosts x 4
-# devices; test_four_process_matches_single_process uses 4 x 2 to exercise
-# rank >= 2 per-host column assembly).  The global mesh is always 8 wide,
-# so every topology checkpoints identically to the single-process run.
-_LOCAL_DEVICES = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
+_PID = int(sys.argv[1])
+_COUNTS = [int(x)
+           for x in os.environ.get("MH_LOCAL_DEVICES", "4").split(",")]
 _NUM_PROCESSES = int(os.environ.get("MH_NUM_PROCESSES", "2"))
+_LOCAL_DEVICES = _COUNTS[_PID] if len(_COUNTS) > 1 else _COUNTS[0]
+_TOTAL_DEVICES = (sum(_COUNTS) if len(_COUNTS) > 1
+                  else _NUM_PROCESSES * _COUNTS[0])
 
 os.environ["XLA_FLAGS"] = (
     f"--xla_force_host_platform_device_count={_LOCAL_DEVICES}")
@@ -41,13 +54,13 @@ jax.config.update("jax_platforms", "cpu")
 
 
 def main() -> None:
-    pid, coordinator, ckpt_path = (int(sys.argv[1]), sys.argv[2], sys.argv[3])
+    pid, coordinator, ckpt_path = (_PID, sys.argv[2], sys.argv[3])
     mode = sys.argv[4] if len(sys.argv) > 4 else "streaming"
     from ddp_tpu.parallel import dist
     dist.initialize(coordinator=coordinator, num_processes=_NUM_PROCESSES,
                     process_id=pid)
     assert jax.process_count() == _NUM_PROCESSES
-    assert jax.device_count() == _NUM_PROCESSES * _LOCAL_DEVICES
+    assert jax.device_count() == _TOTAL_DEVICES
 
     if mode == "cli":
         # Full CLI path on 2 real processes: the periodic eval is a
@@ -70,13 +83,25 @@ def main() -> None:
     from ddp_tpu.parallel import make_mesh
     from ddp_tpu.train import Trainer
 
-    mesh = make_mesh()  # all 8 devices across all processes
+    with_eval = mode.endswith("_eval")
+    resident = mode in ("resident", "zero_resident_eval")
+    shard_update = mode in ("zero", "zero_resident_eval")
+    mesh = make_mesh()  # all devices across all processes
+    n_replicas = mesh.devices.size
     model = get_model("deepnn")
     params, stats = model.init(jax.random.key(0))
-    train_ds, _ = synthetic(n_train=128, seed=5)
-    ldc = jax.local_device_count()
-    local = range(pid * ldc, pid * ldc + ldc)
-    loader = TrainLoader(train_ds, per_replica_batch=4, num_replicas=8,
+    # Eval modes use a ragged 120/72 split (ragged train tail per shard AND
+    # a padded+masked final eval batch); the original modes keep 128.
+    train_ds, test_ds = (synthetic(n_train=120, n_test=72, seed=5)
+                         if with_eval else synthetic(n_train=128, seed=5))
+    # This process's replica rows, derived from the mesh itself (cli.py
+    # does the same) — with per-process device counts the blocks are
+    # unequal, which range arithmetic on a uniform count would get wrong.
+    local = [i for i, d in enumerate(mesh.devices.flat)
+             if d.process_index == jax.process_index()]
+    assert len(local) == _LOCAL_DEVICES
+    loader = TrainLoader(train_ds, per_replica_batch=4,
+                         num_replicas=n_replicas,
                          augment=False, seed=7, local_replicas=local)
     sched = functools.partial(triangular_lr, base_lr=0.1, num_epochs=2,
                               steps_per_epoch=len(loader))
@@ -85,9 +110,23 @@ def main() -> None:
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
                       save_every=1, snapshot_path=ckpt_path, resume=resume,
-                      resident=(mode == "resident"),
-                      shard_update=(mode == "zero"))
+                      resident=resident, shard_update=shard_update)
     trainer.train(epochs)  # process 0 writes the checkpoint (rank-0 gate)
+    if with_eval:
+        from ddp_tpu.data import EvalLoader
+        el = EvalLoader(test_ds, 4, n_replicas, local_replicas=local)
+        if resident:
+            from ddp_tpu.data.resident import ResidentData
+            from ddp_tpu.train.evaluate import evaluate_resident
+            acc = evaluate_resident(model, trainer.state.params,
+                                    trainer.state.batch_stats,
+                                    ResidentData(test_ds, mesh), el, mesh)
+        else:
+            from ddp_tpu.train import evaluate
+            acc = evaluate(model, trainer.state.params,
+                           trainer.state.batch_stats, el, mesh,
+                           progress=False)
+        print(f"MH_EVAL_ACC={acc:.6f}")
     dist.shutdown()
 
 

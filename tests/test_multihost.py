@@ -34,15 +34,17 @@ def _free_port() -> int:
 
 
 def _spawn_workers(ckpt: str, mode: str, extra: list = (), *,
-                   nprocs: int = 2) -> list:
+                   nprocs: int = 2, devices: str = None) -> list:
     """Spawn ``nprocs`` worker 'hosts' splitting the fixed 8-device global
-    mesh evenly (2 x 4 by default; 4 x 2 exercises rank >= 2 assembly)."""
+    mesh evenly (2 x 4 by default; 4 x 2 exercises rank >= 2 assembly), or
+    per ``devices`` — a comma list of per-process device counts for
+    asymmetric topologies (e.g. ``"2,1,1"``)."""
     coord = f"localhost:{_free_port()}"
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["MH_NUM_PROCESSES"] = str(nprocs)
-    env["MH_LOCAL_DEVICES"] = str(8 // nprocs)
+    env["MH_LOCAL_DEVICES"] = devices or str(8 // nprocs)
     procs = [subprocess.Popen(
         [sys.executable, _WORKER, str(pid), coord, ckpt, mode, *extra],
         cwd=_REPO, env=env, stdout=subprocess.PIPE,
@@ -188,6 +190,71 @@ def test_four_process_matches_single_process(tmp_path):
                            ("r", "resident", dict(rtol=1e-4, atol=1e-5))]:
         (tmp_path / sub).mkdir()
         _run_and_compare(tmp_path / sub, mode, nprocs=4, **tol)
+
+
+@pytest.mark.slow
+def test_three_process_asymmetric_matches_single_process(tmp_path):
+    """3 processes over a 4-device mesh split 2/1/1 (VERDICT r3 #3): no
+    prior multi-host test used >2 ranks with UNEQUAL host->replica blocks,
+    and none drove the EvalLoader across processes at all.  Covers
+    multi-host TrainLoader feeding with a ragged tail (120/4-replica split
+    -> 7 full + ragged 2 per shard), the EvalLoader's multi-process
+    row-block (__iter__) and index-matrix column-slicing
+    (epoch_index_matrix) paths with a padded+masked final batch (72 test
+    rows, global batch 16), and the zero+resident composition — each
+    against the single-process 4-device run of identical configuration."""
+    from ddp_tpu.data import EvalLoader
+    from ddp_tpu.data.resident import ResidentData
+    from ddp_tpu.train import evaluate
+    from ddp_tpu.train.evaluate import evaluate_resident
+
+    for sub, mode, tol in [
+            ("s", "streaming_eval", dict(rtol=1e-6, atol=1e-7)),
+            ("zr", "zero_resident_eval", dict(rtol=1e-4, atol=1e-5))]:
+        (tmp_path / sub).mkdir()
+        ckpt = str(tmp_path / sub / "mh.pt")
+        outs = _spawn_workers(ckpt, mode, nprocs=3, devices="2,1,1")
+        accs = [float(l.split("=", 1)[1]) for o in outs
+                for l in o.splitlines() if l.startswith("MH_EVAL_ACC=")]
+        assert len(accs) == 3  # the psum counters agree on every process
+        assert max(accs) - min(accs) < 1e-6
+
+        # Ground truth: same run, one process, 4 of the conftest's devices.
+        resident = mode == "zero_resident_eval"
+        mesh = make_mesh(4)
+        model = get_model("deepnn")
+        params, stats = model.init(jax.random.key(0))
+        train_ds, test_ds = synthetic(n_train=120, n_test=72, seed=5)
+        loader = TrainLoader(train_ds, per_replica_batch=4, num_replicas=4,
+                             augment=False, seed=7)
+        sched = functools.partial(triangular_lr, base_lr=0.1, num_epochs=2,
+                                  steps_per_epoch=len(loader))
+        trainer = Trainer(model, loader, params, stats, mesh=mesh,
+                          lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
+                          save_every=100,
+                          snapshot_path=str(tmp_path / sub / "sp.pt"),
+                          resident=resident, shard_update=resident)
+        trainer.train(2)
+        el = EvalLoader(test_ds, 4, 4)
+        if resident:
+            want_acc = evaluate_resident(
+                model, trainer.state.params, trainer.state.batch_stats,
+                ResidentData(test_ds, mesh), el, mesh)
+        else:
+            want_acc = evaluate(model, trainer.state.params,
+                                trainer.state.batch_stats, el, mesh,
+                                progress=False)
+        assert abs(accs[0] - want_acc) < 1e-4, (mode, accs[0], want_acc)
+
+        got = load_checkpoint(ckpt)
+        want = jax.device_get(trainer.state.params)
+        for (pw, w), (pg, g) in zip(
+                jax.tree_util.tree_leaves_with_path(want),
+                jax.tree_util.tree_leaves_with_path(got.params)):
+            assert pw == pg
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       err_msg=f"{mode} {pw}", **tol)
+        assert got.step == int(trainer.state.step)
 
 
 @pytest.mark.extended  # multi-host zero; default reprs: test_two_process_matches_single_process + test_zero_matches_replicated

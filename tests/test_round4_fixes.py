@@ -164,6 +164,20 @@ def test_concurrent_traces_no_bn_crosstalk():
     assert results == {"sync": True, "plain": False}
 
 
+def test_process_min_mib_int32_safe():
+    """Real HBM byte capacities (2^34+) must survive the device round-trip
+    — int64 canonicalizes to int32 without x64, where 16 GiB wraps to
+    exactly 0 — so the value crosses as MiB.  None means 'no limit' and
+    wins the min."""
+    from ddp_tpu.parallel.mesh import process_min_mib
+    mesh = make_mesh(2)
+    for bytes_in, want in [(16 * 2 ** 30, 16 * 2 ** 30),   # 16 GiB exact
+                           (2 ** 34 + 5 * 2 ** 20, 2 ** 34 + 5 * 2 ** 20),
+                           (123, 0),                        # sub-MiB floors
+                           (None, None)]:
+        assert process_min_mib(mesh, bytes_in) == want
+
+
 def test_label_noise_without_synthetic_refuses():
     """--synthetic_label_noise without --synthetic must error, not be
     silently ignored (ADVICE r3)."""
