@@ -99,16 +99,19 @@ def _best_of(fn, x, w, repeats: int) -> float:
 
 
 def probe(batch: int = 512, repeats: int = 6, dtype=jnp.float32,
-          conv=conv2d) -> list:
+          conv=conv2d, shapes=None) -> list:
     """Marginal per-call ms and achieved TFLOP/s for each VGG conv shape.
 
     ``conv`` is pluggable (signature ``conv(x, w) -> y``) so alternative
     implementations (e.g. Pallas kernels) can be measured under the
-    identical harness for an apples-to-apples comparison.  The default
-    ``repeats=6`` matches the recorded BASELINE.md methodology.
+    identical harness for an apples-to-apples comparison (the candidates
+    live in :mod:`~ddp_tpu.ops.conv_candidates`); ``shapes`` restricts the
+    sweep (default: every VGG conv shape).  The default ``repeats=6``
+    matches the recorded BASELINE.md methodology.
     """
     records = []
-    for h, cin, cout, reps in VGG_CONV_SHAPES:
+    for h, cin, cout, reps in (VGG_CONV_SHAPES if shapes is None
+                               else shapes):
         x = jax.random.normal(jax.random.key(0), (batch, h, h, cin), dtype)
         # .astype: the numpy scalar is strongly typed, so the bare product
         # would silently promote a bfloat16 w back to float32.

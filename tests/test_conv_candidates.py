@@ -1,0 +1,46 @@
+"""Conv-probe candidate kernels (ops/conv_candidates.py) must be
+numerically the conv2d contract — forward AND the custom VJP (dgrad via
+flipped-transposed forward, wgrad via shifted matmuls) — before their
+measurements mean anything (VERDICT r3 missing #3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_tpu.ops import conv_candidates as cc
+from ddp_tpu.ops.layers import conv2d
+
+
+def _check(cand, n=4, h=8, cin=16, cout=32, tol=1e-4):
+    kx = jax.random.normal(jax.random.key(0), (n, h, h, cin), jnp.float32)
+    kw = jax.random.normal(jax.random.key(1), (3, 3, cin, cout),
+                           jnp.float32) * 0.1
+
+    def loss_ref(x, w):
+        return jnp.sum(jnp.sin(conv2d(x, w)))
+
+    def loss_cand(x, w):
+        return jnp.sum(jnp.sin(cand(x, w)))
+
+    want, (gx_w, gw_w) = jax.value_and_grad(loss_ref, (0, 1))(kx, kw)
+    got, (gx_g, gw_g) = jax.value_and_grad(loss_cand, (0, 1))(kx, kw)
+    np.testing.assert_allclose(float(got), float(want), rtol=tol)
+    np.testing.assert_allclose(np.asarray(gx_g), np.asarray(gx_w),
+                               rtol=tol, atol=tol)
+    np.testing.assert_allclose(np.asarray(gw_g), np.asarray(gw_w),
+                               rtol=tol, atol=tol)
+
+
+def test_shift9_matches_conv2d():
+    _check(cc.conv2d_shift9)
+
+
+def test_im2col_matches_conv2d():
+    _check(cc.conv2d_im2col)
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="Pallas TPU kernel; run on the chip "
+                           "(tools/ or conv_candidates CLI verify it there)")
+def test_pallas_matches_conv2d():
+    _check(cc.conv2d_pallas)
