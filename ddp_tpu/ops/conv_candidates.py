@@ -84,10 +84,12 @@ def _im2col_fwd(x, w):
 
 
 def _pick_block_n(n, h, cin, cout, bytes_per_el):
-    """Largest batch tile whose VMEM footprint (padded input block + one
-    shifted-slice copy + fp32 accumulator + weights) stays within ~12 of
-    the ~16 MiB VMEM."""
-    budget = 12 * 2 ** 20
+    """Largest batch tile whose ESTIMATED VMEM footprint (padded input
+    block + one shifted-slice copy + fp32 accumulator + weights) fits a
+    6 MiB budget — Mosaic's actual stack allocation measured ~2x this
+    estimate (double-buffered blocks + live dot operands), and the scoped
+    limit is 16 MiB, so 6 MiB estimated keeps the real footprint inside."""
+    budget = 6 * 2 ** 20
     w_bytes = 9 * cin * cout * bytes_per_el
     for bn in (128, 64, 32, 16, 8, 4, 2, 1):
         if n % bn:
@@ -158,9 +160,21 @@ def _wgrad(x, dy):
     return jnp.stack(rows).reshape(3, 3, cin, cout).astype(x.dtype)
 
 
-def _with_vjp(fwd):
-    """Wrap a forward into the probe's conv contract with the shared
-    backward: dgrad via the same fast forward, wgrad via shifted matmuls."""
+def _xla_bwd(res, dy):
+    """Backward delegated to XLA's own dgrad/wgrad conv emitters (which
+    beat the hand shifted-matmul wgrad in measurement).  jax.vjp runs the
+    primal forward too, but its output feeds nothing and XLA DCEs it
+    under jit — the backward program that remains is the baseline's."""
+    from .layers import conv2d
+    x, w = res
+    _, vjp = jax.vjp(conv2d, x, w)
+    return vjp(dy)
+
+
+def _with_vjp(fwd, bwd=None):
+    """Wrap a forward into the probe's conv contract.  Default backward:
+    dgrad via the same fast forward (a SAME 3x3 conv of dy with the
+    flipped, transposed kernel), wgrad via shifted matmuls."""
 
     @jax.custom_vjp
     def conv(x, w):
@@ -173,19 +187,25 @@ def _with_vjp(fwd):
         x, w = res
         return fwd(dy, _flip_transpose(w)), _wgrad(x, dy)
 
-    conv.defvjp(conv_fwd, conv_bwd)
+    conv.defvjp(conv_fwd, bwd or conv_bwd)
     return conv
 
 
 conv2d_shift9 = _with_vjp(_shift9_fwd)
 conv2d_im2col = _with_vjp(_im2col_fwd)
 conv2d_pallas = _with_vjp(_pallas_fwd)
+# The hybrid an early single-candidate run suggested could win (Pallas
+# forward at an apparent 197.6 TFLOP/s vs 175.6).  The same-process
+# head-to-head (BASELINE.md round-4 table) shows it LOSING every cell —
+# that early delta was harness noise.  Kept as the measured negative.
+conv2d_pallas_fwd_xla_bwd = _with_vjp(_pallas_fwd, bwd=_xla_bwd)
 
 CANDIDATES = {
     "baseline_xla_conv": None,  # conv_probe's default conv2d
     "shift9_lax": conv2d_shift9,
     "im2col_lax": conv2d_im2col,
     "shift9_fused_pallas": conv2d_pallas,
+    "pallas_fwd_xla_bwd": conv2d_pallas_fwd_xla_bwd,
 }
 
 # The two sub-peak shapes the round-3 roofline flagged (plus reps=1).
