@@ -88,6 +88,14 @@ class Trainer:
         self.loss_history: List[float] = []
         self._save_thread = None
         self._save_error: Optional[BaseException] = None
+        # Deferred loss read (epoch pipelining): (epoch, start_step,
+        # stacked device array) of the newest epoch whose losses have not
+        # been host-read yet — flushed only after the NEXT epoch is
+        # dispatched, so the D2H read (a tunnel round trip on remote
+        # devices) overlaps device compute instead of idling the chips at
+        # every epoch boundary (measured 2.1 ms/step of device idle at
+        # 98-step epochs before this, BASELINE.md round 4).
+        self._pending_losses = None
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
         if resume and snapshot_path and os.path.exists(snapshot_path):
@@ -99,6 +107,10 @@ class Trainer:
                 jnp.asarray(ckpt.step, jnp.int32))
             self.start_epoch = ckpt.epoch + 1
             print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
+        # Host-side mirror of state.step: reading the device scalar would
+        # block on the in-flight epoch (the exact stall the deferred loss
+        # read removes), and the step count per epoch is host-known.
+        self._host_step = int(self.state.step)
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
         if shard_update:
@@ -223,7 +235,19 @@ class Trainer:
         stacked = (self._epoch_losses_resident() if self.resident is not None
                    else self._epoch_losses_streaming())
         n_losses = int(stacked.shape[0]) if stacked is not None else 0
-        start_step = int(self.state.step) - n_losses
+        start_step = self._host_step
+        self._host_step += n_losses
+        # Defer the host read: flush the PREVIOUS epoch's losses now that
+        # this epoch's work is queued behind them — the D2H transfer and
+        # the next epoch's host prep then overlap device compute.  This
+        # epoch's array is read at the next epoch's dispatch (or by
+        # train()'s final flush).
+        prev, self._pending_losses = (self._pending_losses,
+                                      (epoch, start_step, stacked))
+        if prev is not None:
+            self._flush_losses(*prev)
+
+    def _flush_losses(self, epoch: int, start_step: int, stacked) -> None:
         # One stacked D2H transfer for the whole epoch's losses — per-scalar
         # reads pay a link round trip each on remote-device setups.
         losses = (np.asarray(jax.device_get(stacked)).tolist()
@@ -236,6 +260,11 @@ class Trainer:
             for i, (loss, lr) in enumerate(zip(losses, lrs)):
                 self.metrics.log_step(step=start_step + i, epoch=epoch,
                                       loss=loss, lr=float(lr))
+
+    def _flush_pending_losses(self) -> None:
+        prev, self._pending_losses = self._pending_losses, None
+        if prev is not None:
+            self._flush_losses(*prev)
 
     def _join_pending_save(self) -> None:
         """Wait for the in-flight async checkpoint write, re-raising any
@@ -270,6 +299,19 @@ class Trainer:
                 raise err
 
     def _save_checkpoint(self, epoch: int) -> None:
+        # XLA:CPU hazard gate — BEFORE anything (the ZeRO conversion
+        # below included) enqueues work behind the in-flight epoch: the
+        # CPU backend executes per-device programs on a shared thread
+        # pool and joins cross-device all-reduces via a rendezvous that
+        # needs every participant running.  Dependent executions queued
+        # behind the epoch's collective programs can fill the pool with
+        # blocked threads and deadlock the rendezvous (observed:
+        # "Expected 8 threads ... only 7 arrived", fatal Check).  TPU
+        # streams have no such hazard, so only CPU pays the
+        # serialization — which is exactly the (implicit)
+        # pre-pipelining behavior the CPU test tier always ran with.
+        if jax.default_backend() == "cpu":
+            jax.block_until_ready(self.state)
         # Canonical per-leaf momentum in the file regardless of the
         # in-memory layout: snapshots interchange across modes.  The
         # conversion is a COLLECTIVE under multi-host (all-gather of the
@@ -303,7 +345,9 @@ class Trainer:
                 (snap_params, snap_stats, snap_opt)):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
-        step = int(self.state.step)
+        # Host mirror, not int(self.state.step): the device scalar would
+        # block the epoch loop on the in-flight epoch's completion.
+        step = self._host_step
 
         def write():
             try:
@@ -331,7 +375,14 @@ class Trainer:
                 if self.snapshot_path and epoch % self.save_every == 0:
                     self._save_checkpoint(epoch)
                 if epoch_callback is not None:
+                    # Callbacks must observe THIS epoch's losses/metrics
+                    # (early stopping reads loss_history; the metrics
+                    # stream stays chronological) — and a callback that
+                    # evaluates blocks on the epoch anyway, so the flush
+                    # costs nothing extra there.
+                    self._flush_pending_losses()
                     epoch_callback(epoch)
+            self._flush_pending_losses()
         finally:
             # The last checkpoint write must be on disk before train()
             # returns (resume and the reference's artifact contract depend
@@ -341,10 +392,15 @@ class Trainer:
             if sys.exc_info()[1] is None:
                 self._join_pending_save()
             else:
-                # Already unwinding: still wait for the writer, but don't
-                # let a stale save error REPLACE the in-flight exception
-                # (e.g. a KeyboardInterrupt a caller handles for graceful
-                # shutdown) — report it instead.
+                # Already unwinding: still land the deferred losses and
+                # wait for the writer, but don't let THEIR errors REPLACE
+                # the in-flight exception (e.g. a KeyboardInterrupt a
+                # caller handles for graceful shutdown) — report instead.
+                try:
+                    self._flush_pending_losses()
+                except BaseException as e:
+                    print(f"deferred loss read failed during shutdown: "
+                          f"{e!r}", file=sys.stderr)
                 try:
                     self._join_pending_save()
                 except BaseException as e:
