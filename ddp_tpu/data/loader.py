@@ -18,7 +18,7 @@ free there.
 """
 from __future__ import annotations
 
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional, Sequence
 
 import numpy as np
 
@@ -41,7 +41,7 @@ class TrainLoader:
     def __init__(self, dataset: Dataset, per_replica_batch: int,
                  num_replicas: int = 1, *, shuffle: bool = True,
                  augment: bool = True, seed: int = 0,
-                 local_replicas: Optional[range] = None):
+                 local_replicas: Optional[Sequence[int]] = None):
         self.dataset = dataset
         self.per_replica_batch = per_replica_batch
         self.num_replicas = num_replicas
@@ -153,7 +153,7 @@ class EvalLoader:
 
     def __init__(self, dataset: Dataset, per_replica_batch: int,
                  num_replicas: int = 1,
-                 local_replicas: Optional[range] = None):
+                 local_replicas: Optional[Sequence[int]] = None):
         self.dataset = dataset
         self.global_batch = per_replica_batch * num_replicas
         self.num_replicas = num_replicas
