@@ -3,9 +3,12 @@ CIFAR-shaped data, the reference's workload — singlegpu.py:134, batch 512,
 multigpu.py:259).
 
 Prints ONE JSON line on stdout: {"metric", "value", "unit", "vs_baseline"},
-plus "device_ms_per_step" and — for models with a FLOP model, on real
-accelerators — "mfu" (absolute efficiency against the measured bf16-pass
-MXU peak, so the driver tail self-interprets across rounds).
+plus "wall_ms_per_step" (best-of-windows WALL time per step — includes
+dispatch/tunnel overhead, so it upper-bounds device-busy time; the
+profiler gives the device-only number) and — for models with a FLOP
+model, on real accelerators — "mfu" (absolute efficiency against the
+measured bf16-pass MXU peak, so the driver tail self-interprets across
+rounds).
 The reference publishes no numbers (SURVEY.md §6; BASELINE.json
 "published": {}), so ``vs_baseline`` is reported against this framework's
 recorded fp32 baseline when present in BASELINE_BENCH (below), else 1.0.
@@ -233,8 +236,10 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
             "unit": "samples/sec/chip",
             "vs_baseline": round(vs, 3),
             # Absolute-efficiency context so the driver tail self-
-            # interprets across rounds (VERDICT r3 weak #5).
-            "device_ms_per_step": round(dt / args.steps * 1000.0, 3),
+            # interprets across rounds (VERDICT r3 weak #5).  Named for
+            # what it is: WALL time per step (the window includes
+            # dispatch/tunnel overhead), an upper bound on device-busy.
+            "wall_ms_per_step": round(dt / args.steps * 1000.0, 3),
         }
         gflop = TRAIN_GFLOP_PER_SAMPLE.get(args.model)
         if gflop is not None and jax.default_backend() != "cpu":

@@ -325,8 +325,8 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     # shard DistributedSampler semantics, multigpu.py:153); single-host this
     # is the full range.  Derived from the mesh itself so a --num_devices
     # override (mesh smaller than the local device count) stays consistent.
-    local_replicas = [i for i, d in enumerate(mesh.devices.flat)
-                      if d.process_index == jax.process_index()]
+    from .parallel.mesh import local_replica_ids
+    local_replicas = local_replica_ids(mesh)
     device_augment = args.device_augment or args.resident
     train_loader = TrainLoader(train_ds, args.batch_size, n_replicas,
                                seed=args.seed, local_replicas=local_replicas,
@@ -405,6 +405,11 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         # print/metrics record is rank-0-gated like the Trainer's per-step
         # stream, keeping the two metric streams consistent on multi-host.
         if args.eval_every and (epoch + 1) % args.eval_every == 0:
+            # Land this epoch's deferred loss records first so the
+            # metrics stream stays chronological (the eval blocks on the
+            # epoch anyway, so this flush costs nothing; non-eval epochs
+            # skip it and keep the boundary pipelined).
+            trainer.flush_losses()
             acc = _eval(progress=False)
             last_periodic_eval[:] = [(epoch, acc)]
             if jax.process_index() == 0:

@@ -71,9 +71,11 @@ class ResidentData:
         # every process (a rank that raises while others proceed leaves
         # the others hanging in the assembly collective), so multi-host
         # runs agree on the global minimum limit — with "no limit
-        # reported anywhere" disabling the guard everywhere.
-        local = [d for d in mesh.devices.flat
-                 if d.process_index == jax.process_index()]
+        # reported anywhere" disabling the guard everywhere.  (A process
+        # owning NO mesh devices is unsupported throughout — it gets
+        # assemble_from_local's explicit error below.)
+        from ..parallel.mesh import local_replica_ids
+        local = [mesh.devices.flat[i] for i in local_replica_ids(mesh)]
         limit = _device_bytes_limit(local[0]) if local else None
         if jax.process_count() > 1:
             # Mesh-based global min (NOT multihost_utils.process_allgather,

@@ -56,6 +56,18 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def local_replica_ids(mesh: Mesh) -> list:
+    """Flat-mesh positions of THIS process's devices, in mesh order — the
+    replica ids this process feeds (loaders' ``local_replicas``) and the
+    one definition the per-process assembly order hangs on
+    (:func:`assemble_from_local` assumes ascending mesh order).  Asymmetric
+    topologies make the blocks unequal, so every consumer must derive
+    them from the mesh like this rather than from range arithmetic on a
+    uniform per-host count."""
+    return [i for i, d in enumerate(mesh.devices.flat)
+            if d.process_index == jax.process_index()]
+
+
 def assemble_from_local(sharding: NamedSharding, v, axis: int) -> jax.Array:
     """``jax.make_array_from_process_local_data`` with the global shape made
     EXPLICIT along the sharded ``axis``: the library's inference assumes
@@ -73,6 +85,11 @@ def assemble_from_local(sharding: NamedSharding, v, axis: int) -> jax.Array:
             "process must hold at least one mesh device)")
     n_total = sharding.mesh.devices.size
     shape = list(v.shape)
+    if shape[axis] % n_local:
+        raise ValueError(
+            f"process-local extent {shape[axis]} along axis {axis} is not "
+            f"divisible by this process's {n_local} mesh devices — each "
+            "local device must hold an equal block")
     shape[axis] = shape[axis] // n_local * n_total
     return jax.make_array_from_process_local_data(sharding, v, tuple(shape))
 
@@ -89,14 +106,18 @@ def process_min_mib(mesh: Mesh, value_bytes: Optional[int]) -> Optional[int]:
     x64 enabled JAX canonicalizes int64 to int32, where real HBM byte
     capacities (2^34...) overflow — 16 GiB wraps to exactly 0 — while MiB
     counts stay int32-exact up to 2 TiB.  Returns floor-MiB bytes (the
-    guard's comparison tolerance is far coarser than 1 MiB)."""
+    guard's comparison tolerance is far coarser than 1 MiB).
+
+    Every participating process must own at least one mesh device — a
+    deviceless process cannot contribute to (or read) the collective and
+    gets :func:`assemble_from_local`'s explicit error; such topologies
+    are unsupported throughout (an SPMD program over the mesh has no
+    work for that process)."""
     import jax.numpy as jnp
     mib = -1 if value_bytes is None else value_bytes // 2 ** 20
-    local = [d for d in mesh.devices.flat
-             if d.process_index == jax.process_index()]
     vals = assemble_from_local(
         batch_sharding(mesh),
-        np.full(max(len(local), 1), mib, np.int32), 0)
+        np.full(len(local_replica_ids(mesh)), mib, np.int32), 0)
     gmin = int(jax.jit(jnp.min,
                        out_shardings=replicated_sharding(mesh))(vals))
     return None if gmin < 0 else gmin * 2 ** 20

@@ -123,7 +123,17 @@ def load_checkpoint(path: str) -> Checkpoint:
             f"checkpoint {path!r} is not a readable npz archive "
             f"({type(e).__name__}: {e}); the file is torn or is not a "
             "ddp_tpu checkpoint") from e
-    version = int(flat.get("meta/format_version", 1))
+    def _scalar(key: str, default=None) -> int:
+        val = flat.get(key, default)
+        try:
+            return int(val)
+        except (TypeError, ValueError) as e:
+            raise CheckpointError(
+                f"checkpoint {path!r} has a non-scalar {key} entry "
+                f"(shape {getattr(val, 'shape', '?')}); the file was not "
+                "written by ddp_tpu or is damaged") from e
+
+    version = _scalar("meta/format_version", 1)
     if version > FORMAT_VERSION:
         raise CheckpointError(
             f"checkpoint {path!r} has format_version {version}, newer than "
@@ -134,9 +144,14 @@ def load_checkpoint(path: str) -> Checkpoint:
         section, _, rest = key.partition("/")
         if section in sections:
             sections[section][rest] = val
-    if missing or not sections["params"]:
+    # batch_stats may be legitimately empty (a BN-free model); momentum
+    # always mirrors params, so params-without-momentum means a foreign
+    # or partially-written file — better a named error here than an
+    # obscure tree mismatch inside the optimizer later.
+    if missing or not sections["params"] or not sections["momentum"]:
         what = (f"missing keys {missing}" if missing
-                else "no params/ entries")
+                else "no params/ entries" if not sections["params"]
+                else "params/ present but no momentum/ entries")
         raise CheckpointError(
             f"checkpoint {path!r} is a valid npz but not a ddp_tpu "
             f"checkpoint ({what}); it may be truncated or written by "
@@ -145,6 +160,6 @@ def load_checkpoint(path: str) -> Checkpoint:
         params=_unflatten(sections["params"]),
         batch_stats=_unflatten(sections["batch_stats"]),
         opt_state=SGDState(_unflatten(sections["momentum"])),
-        step=int(flat["meta/step"]),
-        epoch=int(flat["meta/epoch"]),
+        step=_scalar("meta/step"),
+        epoch=_scalar("meta/epoch"),
     )

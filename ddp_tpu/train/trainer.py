@@ -261,7 +261,16 @@ class Trainer:
                 self.metrics.log_step(step=start_step + i, epoch=epoch,
                                       loss=loss, lr=float(lr))
 
-    def _flush_pending_losses(self) -> None:
+    def flush_losses(self) -> None:
+        """Host-read any deferred epoch losses now (blocks on the epoch).
+
+        The epoch loop defers each epoch's loss D2H until the next
+        epoch's work is dispatched, so ``loss_history``/the metrics
+        stream can lag one epoch mid-run.  An ``epoch_callback`` that
+        reads them (early stopping, eval-record ordering) calls this
+        first — a callback that's a no-op this epoch then costs
+        nothing, keeping the pipelining (a flush on every callback
+        epoch would re-serialize the boundary it exists to hide)."""
         prev, self._pending_losses = self._pending_losses, None
         if prev is not None:
             self._flush_losses(*prev)
@@ -375,14 +384,13 @@ class Trainer:
                 if self.snapshot_path and epoch % self.save_every == 0:
                     self._save_checkpoint(epoch)
                 if epoch_callback is not None:
-                    # Callbacks must observe THIS epoch's losses/metrics
-                    # (early stopping reads loss_history; the metrics
-                    # stream stays chronological) — and a callback that
-                    # evaluates blocks on the epoch anyway, so the flush
-                    # costs nothing extra there.
-                    self._flush_pending_losses()
+                    # NB: the epoch's losses may still be deferred here —
+                    # a callback that reads loss_history/metrics calls
+                    # trainer.flush_losses() itself (see its docstring;
+                    # an unconditional flush would re-serialize every
+                    # epoch boundary for monitored runs).
                     epoch_callback(epoch)
-            self._flush_pending_losses()
+            self.flush_losses()
         finally:
             # The last checkpoint write must be on disk before train()
             # returns (resume and the reference's artifact contract depend
@@ -397,7 +405,7 @@ class Trainer:
                 # the in-flight exception (e.g. a KeyboardInterrupt a
                 # caller handles for graceful shutdown) — report instead.
                 try:
-                    self._flush_pending_losses()
+                    self.flush_losses()
                 except BaseException as e:
                     print(f"deferred loss read failed during shutdown: "
                           f"{e!r}", file=sys.stderr)

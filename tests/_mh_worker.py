@@ -94,11 +94,12 @@ def main() -> None:
     # a padded+masked final eval batch); the original modes keep 128.
     train_ds, test_ds = (synthetic(n_train=120, n_test=72, seed=5)
                          if with_eval else synthetic(n_train=128, seed=5))
-    # This process's replica rows, derived from the mesh itself (cli.py
-    # does the same) — with per-process device counts the blocks are
-    # unequal, which range arithmetic on a uniform count would get wrong.
-    local = [i for i, d in enumerate(mesh.devices.flat)
-             if d.process_index == jax.process_index()]
+    # This process's replica rows, derived from the mesh itself (the one
+    # shared definition cli.py also uses) — with per-process device
+    # counts the blocks are unequal, which range arithmetic on a uniform
+    # count would get wrong.
+    from ddp_tpu.parallel.mesh import local_replica_ids
+    local = local_replica_ids(mesh)
     assert len(local) == _LOCAL_DEVICES
     loader = TrainLoader(train_ds, per_replica_batch=4,
                          num_replicas=n_replicas,

@@ -36,13 +36,13 @@ def test_bench_prints_one_json_line():
     lines = [l for l in out.stdout.splitlines() if l.strip()]
     assert len(lines) == 1, out.stdout
     rec = json.loads(lines[0])
-    # The four driver keys plus device_ms_per_step (absolute-efficiency
+    # The four driver keys plus wall_ms_per_step (absolute-efficiency
     # context; an "mfu" key joins on models with a FLOP model, on real
     # accelerators only — not this CPU-mesh child).
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
-                        "device_ms_per_step"}
+                        "wall_ms_per_step"}
     assert rec["value"] > 0 and rec["unit"] == "samples/sec/chip"
-    assert rec["device_ms_per_step"] > 0
+    assert rec["wall_ms_per_step"] > 0
 
 
 def test_graft_entry_compiles():

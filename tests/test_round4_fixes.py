@@ -164,6 +164,35 @@ def test_concurrent_traces_no_bn_crosstalk():
     assert results == {"sync": True, "plain": False}
 
 
+def test_pipelined_losses_complete_on_abort():
+    """Epoch pipelining defers the loss D2H — but an abort mid-run must
+    still land every completed epoch's losses in loss_history (the
+    callback flush + the unwinding flush in train()'s finally)."""
+    ds, _ = synthetic(n_train=64, n_test=64, seed=3)
+    mesh = make_mesh(2)
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    loader = TrainLoader(ds, per_replica_batch=8, num_replicas=2,
+                         augment=False, seed=1)
+    sched = functools.partial(triangular_lr, base_lr=0.05, num_epochs=3,
+                              steps_per_epoch=len(loader))
+    tr = Trainer(model, loader, params, stats, mesh=mesh, lr_schedule=sched,
+                 sgd_config=SGDConfig(lr=0.05), save_every=10**9,
+                 snapshot_path=None)
+
+    def abort_after_epoch_1(epoch):
+        if epoch == 1:
+            raise RuntimeError("user abort")
+
+    import pytest
+    with pytest.raises(RuntimeError, match="user abort"):
+        tr.train(3, epoch_callback=abort_after_epoch_1)
+    # Epochs 0 and 1 ran to completion; both must be in the history even
+    # though epoch 1's read was deferred at the moment of the abort.
+    assert len(tr.loss_history) == 2 * len(loader)
+    assert all(np.isfinite(l) for l in tr.loss_history)
+
+
 def test_process_min_mib_int32_safe():
     """Real HBM byte capacities (2^34+) must survive the device round-trip
     — int64 canonicalizes to int32 without x64, where 16 GiB wraps to
