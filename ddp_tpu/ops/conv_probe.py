@@ -88,12 +88,22 @@ def _train_chain(n: int, conv):
     return jax.jit(win)
 
 
-def _best_of(fn, x, w, repeats: int) -> float:
-    float(fn(x, w))  # compile + warm
+# Tunnel-jitter threshold: a marginal below 0.1 ms/call cannot be
+# distinguished from link noise at these chain lengths (shared by the
+# pool probe so the two methodologies cannot drift).
+NOISE_S_PER_CALL = 1e-4
+
+
+def best_of(fn, args, repeats: int) -> float:
+    """Best-of-``repeats`` wall time of ``fn(*args)``, synced via a host
+    value read (the one sync that cannot return early through remote
+    device tunnels).  The shared timing core of every probe in this
+    package."""
+    float(fn(*args))  # compile + warm
     dt = float("inf")
     for _ in range(repeats):
         t0 = time.perf_counter()
-        float(fn(x, w))
+        float(fn(*args))
         dt = min(dt, time.perf_counter() - t0)
     return dt
 
@@ -120,14 +130,15 @@ def probe(batch: int = 512, repeats: int = 6, dtype=jnp.float32,
         for name, chain, fmult in (("fwd", _fwd_chain, 1.0),
                                    ("train(fwd+dgrad+wgrad)", _train_chain,
                                     3.0)):
-            t_s = _best_of(chain(N_SHORT, conv), x, w, repeats)
-            t_l = _best_of(chain(N_LONG, conv), x, w, repeats)
+            t_s = best_of(chain(N_SHORT, conv), (x, w), repeats)
+            t_l = best_of(chain(N_LONG, conv), (x, w), repeats)
             per_call = max((t_l - t_s) / (N_LONG - N_SHORT), 1e-9)
             fl = conv_flops(batch, h, cin, cout) * fmult
             # Tunnel jitter can make t_long <= t_short when the true
             # marginal cost is tiny; flag those rows instead of printing
             # an absurd TFLOP/s as fact.
-            noise_limited = (t_l - t_s) < 1e-4 * (N_LONG - N_SHORT)
+            noise_limited = (t_l - t_s) < NOISE_S_PER_CALL * (N_LONG
+                                                             - N_SHORT)
             rec = {
                 "shape": f"{h}x{h} {cin}->{cout}" + (f" x{reps}" if reps > 1
                                                      else ""),

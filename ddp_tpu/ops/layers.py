@@ -92,7 +92,14 @@ def conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array] = None,
 def max_pool(x: jax.Array, window: int = 2, stride: int = 2,
              padding: int = 0) -> jax.Array:
     """MaxPool2d(window, stride, padding) — reference singlegpu.py:70 uses
-    (2, 2, 0); ResNet-18's stem uses (3, 2, 1)."""
+    (2, 2, 0); ResNet-18's stem uses (3, 2, 1).
+
+    Deliberately the ``reduce_window`` form: a reshape-max alternative
+    with an elementwise first-tie VJP (``ops/pool_candidates.py``)
+    measured 1.6x FASTER in isolation but 20% SLOWER at the whole-step
+    level (its window-view transposes force activation relayouts that
+    fight the conv layouts) — the recorded negative result in
+    BASELINE.md round 4 "pool backward candidate"."""
     return lax.reduce_window(
         x, -jnp.inf, lax.max,
         window_dimensions=(1, window, window, 1),

@@ -51,6 +51,33 @@ def test_max_pool_matches_torch():
                                theirs.numpy().transpose(0, 2, 3, 1))
 
 
+def test_max_pool_backward_tie_routing_matches_torch():
+    """Tied-window max-pool gradients must route to the FIRST maximal
+    element, exactly like torch's MaxPool2d backward — ties are the
+    common case after ReLU (exact zeros).  Pinned for the shipped op
+    AND for the pool-candidate's hand VJP (ops/pool_candidates.py — the
+    measured-negative alternative must stay numerically valid so its
+    measurement stays meaningful)."""
+    from ddp_tpu.ops.pool_candidates import max_pool_reshape
+    rng = np.random.default_rng(7)
+    x = np.maximum(rng.normal(size=(3, 8, 8, 4)) - 0.4, 0.0)  # many 0-ties
+    x[0, 0:2, 0:2, 0] = 1.5  # a forced 4-way non-zero tie
+    x = x.astype(np.float32)
+    dy_np = rng.normal(size=(3, 4, 4, 4)).astype(np.float32)
+
+    xt = torch.from_numpy(x.transpose(0, 3, 1, 2)).requires_grad_(True)
+    yt = F.max_pool2d(xt, 2)
+    yt.backward(torch.from_numpy(dy_np.transpose(0, 3, 1, 2)))
+    want = xt.grad.numpy().transpose(0, 2, 3, 1)
+
+    for pool in (max_pool, max_pool_reshape):
+        def loss(xj):
+            return jnp.sum(pool(xj) * jnp.asarray(dy_np))
+
+        got = np.asarray(jax.grad(loss)(jnp.asarray(x)))
+        np.testing.assert_array_equal(got, want)
+
+
 def test_batch_norm_train_matches_torch():
     x = rand(8, 4, 4, 6)
     bn = torch.nn.BatchNorm2d(6)
