@@ -16,6 +16,9 @@ shard and the collective checkpoint canonicalisation in train/zero.py).
 training (ragged 120/72 synthetic split) and print ``MH_EVAL_ACC=`` —
 driving the multi-process ``EvalLoader`` row-block (__iter__) and
 index-matrix column-slicing (epoch_index_matrix, loader.py) paths.
+``accum`` trains with ``grad_accum=2`` on the ragged split, so the
+flush-on-ragged-tail grouping and the ``optimizer_steps_per_epoch``
+schedule derivation run across real processes.
 ``epochs`` (default 2) is the target epoch count, and a literal ``resume``
 6th argument restores from the checkpoint first — every process reads the
 rank-0 file (the all-host restore of the replicated pytree, BASELINE.json
@@ -86,14 +89,18 @@ def main() -> None:
     with_eval = mode.endswith("_eval")
     resident = mode in ("resident", "zero_resident_eval")
     shard_update = mode in ("zero", "zero_resident_eval")
+    grad_accum = 2 if mode == "accum" else 1
     mesh = make_mesh()  # all devices across all processes
     n_replicas = mesh.devices.size
     model = get_model("deepnn")
     params, stats = model.init(jax.random.key(0))
-    # Eval modes use a ragged 120/72 split (ragged train tail per shard AND
-    # a padded+masked final eval batch); the original modes keep 128.
+    # Eval and accum modes use a ragged 120/72 split (ragged train tail
+    # per shard — under accum that exercises the flush-on-ragged group
+    # and the optimizer_steps_per_epoch schedule derivation — and a
+    # padded+masked final eval batch); the original modes keep 128.
     train_ds, test_ds = (synthetic(n_train=120, n_test=72, seed=5)
-                         if with_eval else synthetic(n_train=128, seed=5))
+                         if with_eval or grad_accum > 1
+                         else synthetic(n_train=128, seed=5))
     # This process's replica rows, derived from the mesh itself (the one
     # shared definition cli.py also uses) — with per-process device
     # counts the blocks are unequal, which range arithmetic on a uniform
@@ -104,14 +111,16 @@ def main() -> None:
     loader = TrainLoader(train_ds, per_replica_batch=4,
                          num_replicas=n_replicas,
                          augment=False, seed=7, local_replicas=local)
-    sched = functools.partial(triangular_lr, base_lr=0.1, num_epochs=2,
-                              steps_per_epoch=len(loader))
+    sched = functools.partial(
+        triangular_lr, base_lr=0.1, num_epochs=2,
+        steps_per_epoch=loader.optimizer_steps_per_epoch(grad_accum))
     epochs = int(sys.argv[5]) if len(sys.argv) > 5 else 2
     resume = len(sys.argv) > 6 and sys.argv[6] == "resume"
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=sched, sgd_config=SGDConfig(lr=0.1),
                       save_every=1, snapshot_path=ckpt_path, resume=resume,
-                      resident=resident, shard_update=shard_update)
+                      resident=resident, shard_update=shard_update,
+                      grad_accum=grad_accum)
     trainer.train(epochs)  # process 0 writes the checkpoint (rank-0 gate)
     if with_eval:
         from ddp_tpu.data import EvalLoader
