@@ -290,8 +290,51 @@ def _enable_compilation_cache() -> None:
 def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     """Train + report, reference ``main()`` order (multigpu.py:224-250):
     setup -> objs -> loader -> train -> time print -> size print -> eval ->
-    accuracy print -> teardown.  Returns the final accuracy (%)."""
+    accuracy print -> teardown.  Returns the final accuracy (%).
+
+    Teardown is exception-safe on multi-host: an exception anywhere in the
+    body (data load, training, final eval, ``--export_torch``) on ONE
+    process would otherwise leave its peers hanging in their next
+    collective — the reference's ``destroy_process_group()``
+    (multigpu.py:250) has the same unprotected shape.  Here the failing
+    process reports the error, tears down its coordination state
+    (``dist.abort``), and HARD-EXITS (``os._exit``): interpreter
+    finalization cannot run, because shutdown GC destroys the runtime's
+    collective machinery whose destructor blocks on the very peers that
+    are stuck waiting for us (measured: a 2-process run's failing worker
+    hung forever in ``Garbage-collecting`` after its traceback printed).
+    The process death closes the sockets and the peers' coordinator
+    heartbeat/error machinery aborts them within its timeout — the same
+    hard-kill discipline NCCL watchdogs use.  Single-host keeps plain
+    raise semantics (there is no peer to unblock and the caller may want
+    the exception)."""
     dist.initialize()  # no-op single-host (reference ddp_setup, multigpu.py:225)
+    try:
+        accuracy = _run_body(args, num_devices=num_devices)
+    except BaseException as err:
+        if jax.process_count() > 1:
+            print(f"FATAL: process {jax.process_index()} failed with "
+                  f"{err!r}; aborting the coordination service and "
+                  "hard-exiting so peer processes abort instead of "
+                  "hanging in their next collective", file=sys.stderr)
+            import traceback
+            traceback.print_exc()
+            sys.stdout.flush()
+            sys.stderr.flush()
+            dist.abort()  # non-graceful: never blocks (dist.py)
+            _hard_exit(1)
+        raise
+    dist.shutdown()  # reference destroy_process_group (multigpu.py:250)
+    return accuracy
+
+
+def _hard_exit(code: int) -> None:  # monkeypatch seam for tests
+    os._exit(code)
+
+
+def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
+    """The reference ``main()`` body proper (multigpu.py:224-248), between
+    rendezvous and teardown — both owned by :func:`run`."""
     _enable_compilation_cache()
     mesh = make_mesh(args.num_devices or num_devices)
     n_replicas = mesh.devices.size
@@ -457,5 +500,4 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         # tf.summary writer buffers minutes of scalars (the JSONL handle
         # is line-buffered).
         metrics.close()
-    dist.shutdown()  # reference destroy_process_group (multigpu.py:250)
     return accuracy

@@ -229,6 +229,10 @@ def main() -> None:
               else TARGET_SHAPES)
     names = (args.candidates.split(",") if args.candidates
              else list(CANDIDATES))
+    unknown = [n for n in names if n not in CANDIDATES]
+    if unknown:
+        p.error(f"unknown candidate(s) {unknown}; "
+                f"valid: {', '.join(CANDIDATES)}")
     dtype = jnp.bfloat16 if args.bf16 else jnp.float32
     for name in names:
         cand = CANDIDATES[name]

@@ -91,6 +91,44 @@ def shutdown() -> None:
         _initialized = False
 
 
+def abort() -> None:
+    """NON-GRACEFUL distributed teardown for abort paths — never blocks.
+
+    ``jax.distributed.shutdown()`` is the graceful teardown: it enters a
+    shutdown barrier and blocks up to ``shutdown_timeout_seconds`` (300 s
+    default) for every other process to arrive — but the peers an abort
+    path exists to unblock are stuck in a collective waiting for US, so
+    the graceful path rides the full timeout (measured: a 2-process CPU
+    run hangs its peer the whole 300 s).  Dropping the runtime-state
+    references instead is instant for this process, and the peers abort
+    promptly: their in-flight gloo collective fails in ~30 s on the CPU
+    harness (measured), and the coordination service's error-poll /
+    heartbeat machinery (<=100 s) is the backstop on real pods — when the
+    failing process owns the service (rank 0), dropping it broadcasts
+    UNAVAILABLE to every polling peer immediately.  Works regardless of
+    whether :func:`initialize` here or the launcher did the init."""
+    global _initialized
+    _initialized = False
+    try:
+        from jax._src import distributed as _internal
+        state = _internal.global_state
+        for attr in ("preemption_sync_manager", "client", "service"):
+            if not hasattr(state, attr):
+                # Plain setattr cannot fail on this class, so layout
+                # drift must be DETECTED, not absorbed — a silently
+                # dead-attribute "abort" would leave the real client
+                # alive to block interpreter finalization.
+                raise AttributeError(attr)
+        state.preemption_sync_manager = None
+        state.client = None  # destructor skips the shutdown barrier
+        state.service = None
+    except Exception:  # internal layout moved: last resort, may block
+        try:
+            jax.distributed.shutdown()
+        except (RuntimeError, ValueError):
+            pass
+
+
 def process_index() -> int:
     """Rank of this host — gates checkpoint writes (multigpu.py:118)."""
     return jax.process_index()

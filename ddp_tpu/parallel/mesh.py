@@ -105,8 +105,11 @@ def process_min_mib(mesh: Mesh, value_bytes: Optional[int]) -> Optional[int]:
     can read.  The value crosses the device in MiB, not bytes: without
     x64 enabled JAX canonicalizes int64 to int32, where real HBM byte
     capacities (2^34...) overflow — 16 GiB wraps to exactly 0 — while MiB
-    counts stay int32-exact up to 2 TiB.  Returns floor-MiB bytes (the
-    guard's comparison tolerance is far coarser than 1 MiB).
+    counts stay int32-exact up to 2 TiB.  Returns ceil-MiB bytes: the
+    guard's comparison tolerance is far coarser than 1 MiB either way, but
+    flooring would turn a reported sub-MiB capacity into 0 bytes and flip
+    the resident-HBM guard from advisory into an unconditional error
+    (unreachable for real HBM sizes; ADVICE r4).
 
     Every participating process must own at least one mesh device — a
     deviceless process cannot contribute to (or read) the collective and
@@ -114,7 +117,7 @@ def process_min_mib(mesh: Mesh, value_bytes: Optional[int]) -> Optional[int]:
     are unsupported throughout (an SPMD program over the mesh has no
     work for that process)."""
     import jax.numpy as jnp
-    mib = -1 if value_bytes is None else value_bytes // 2 ** 20
+    mib = -1 if value_bytes is None else -(-value_bytes // 2 ** 20)
     vals = assemble_from_local(
         batch_sharding(mesh),
         np.full(len(local_replica_ids(mesh)), mib, np.int32), 0)

@@ -295,16 +295,7 @@ class Trainer:
                           "hanging in the next collective",
                           file=sys.stderr)
                     sys.stderr.flush()
-                    # Tear down unconditionally — dist.shutdown() is gated
-                    # on dist.initialize() having done the init, but the
-                    # runtime may have been initialised by the launcher /
-                    # jax.distributed directly, and a no-op here recreates
-                    # the exact peer hang this path exists to prevent.
-                    try:
-                        dist.shutdown()
-                        jax.distributed.shutdown()
-                    except (RuntimeError, ValueError):
-                        pass  # already torn down (e.g. by dist.shutdown())
+                    dist.abort()  # non-graceful: never blocks (dist.py)
                 raise err
 
     def _save_checkpoint(self, epoch: int) -> None:

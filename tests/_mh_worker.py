@@ -27,6 +27,8 @@ config #5).
 ``mode`` ``cli`` drives the full ``ddp_tpu.cli.run`` path instead (with
 ``--eval_every`` + ``--metrics_path`` = <ckpt>.metrics.jsonl) — used to
 assert periodic-eval prints/records are rank-0-gated across real processes.
+``cli_evalfail`` is ``cli`` with an exception injected into process 1's
+final eval (cli.run's distributed-abort guard must unblock process 0).
 
 Topology comes from the spawning test: ``MH_NUM_PROCESSES`` processes and
 ``MH_LOCAL_DEVICES`` devices per process — either one count shared by all
@@ -36,8 +38,12 @@ unequal ranks, but real TPU pods can — asymmetric host->replica blocks,
 VERDICT r3 #3).  The global mesh is all devices, so every topology
 checkpoints identically to the single-process run.
 """
+import faulthandler
 import os
+import signal
 import sys
+
+faulthandler.register(signal.SIGUSR1)  # kill -USR1 <pid> dumps all stacks
 
 _PID = int(sys.argv[1])
 _COUNTS = [int(x)
@@ -65,17 +71,27 @@ def main() -> None:
     assert jax.process_count() == _NUM_PROCESSES
     assert jax.device_count() == _TOTAL_DEVICES
 
-    if mode == "cli":
+    if mode in ("cli", "cli_evalfail"):
         # Full CLI path on 2 real processes: the periodic eval is a
         # collective every process must run, but its print + JSONL record
         # must come from rank 0 only (VERDICT weak #4).  dist.initialize
         # above already rendezvoused; cli.run's own call no-ops.
+        # ``cli_evalfail`` injects an exception into process 1's FINAL eval
+        # while process 0 enters the eval collective for real — exercising
+        # cli.run's distributed-abort guard (VERDICT r4 weak #5): process 1
+        # must tear down the coordinator so process 0 aborts, not hangs.
         from ddp_tpu import cli
-        args = cli.build_parser("t").parse_args(
-            ["2", "100", "--batch_size", "4", "--synthetic", "--model",
-             "deepnn", "--lr", "0.05", "--synthetic_size", "64",
-             "--eval_every", "1", "--metrics_path",
-             ckpt_path + ".metrics.jsonl", "--snapshot_path", ckpt_path])
+        argv = ["2", "100", "--batch_size", "4", "--synthetic", "--model",
+                "deepnn", "--lr", "0.05", "--synthetic_size", "64",
+                "--snapshot_path", ckpt_path]
+        if mode == "cli":
+            argv += ["--eval_every", "1",
+                     "--metrics_path", ckpt_path + ".metrics.jsonl"]
+        elif pid == 1:
+            def _boom(*a, **k):
+                raise RuntimeError("injected eval failure")
+            cli.evaluate = _boom
+        args = cli.build_parser("t").parse_args(argv)
         cli.run(args, num_devices=None)
         return
 

@@ -2,6 +2,8 @@
 (SURVEY.md section 4: 'sharding tests asserting each host loads a disjoint,
 padded, epoch-reshuffled index set identical to DistributedSampler
 semantics')."""
+import os
+
 import numpy as np
 import pytest
 import torch
@@ -130,6 +132,59 @@ def test_to_float_matches_totensor_scaling():
     # Exact torchvision ToTensor scaling: x / 255.
     t = torch.from_numpy(batch.transpose(0, 3, 1, 2)).float() / 255.0
     np.testing.assert_allclose(f[0, :, :, 0], t[0, 0].numpy())
+
+
+def test_load_generated_multibatch_archive(tmp_path):
+    """cifar10.load over a make_fake_cifar-generated archive (VERDICT r4
+    weak #1: the multi-batch parse had only ever seen the single 38 KB
+    fixture): 5-file concat order, bytes-keyed pickles (the real files
+    unpickle with encoding="bytes"), CHW->NHWC transpose, and the
+    learnable signal surviving the round trip."""
+    from ddp_tpu.data import cifar10
+    from make_fake_cifar import generate
+
+    base = generate(str(tmp_path), per_batch=64, test_count=32, seed=3)
+    assert sorted(os.listdir(base)) == sorted(
+        [f"data_batch_{i}" for i in range(1, 6)]
+        + ["test_batch", "batches.meta"])
+    train, test = cifar10.load(str(tmp_path), download=False)
+    assert train.images.shape == (320, 32, 32, 3)  # 5 batches concatenated
+    assert test.images.shape == (32, 32, 32, 3)
+    assert train.images.dtype == np.uint8 and train.labels.dtype == np.int32
+    # Transpose check: the generator writes CHW rasters; a wrong reshape/
+    # transpose would scramble the per-image brightness->label signal.
+    mean_by_label = [train.images[train.labels == c].mean()
+                     for c in range(10) if (train.labels == c).any()]
+    assert all(a < b for a, b in zip(mean_by_label, mean_by_label[1:]))
+    # Concat order: regenerating batch 1 alone must equal the first rows.
+    base2 = generate(str(tmp_path / "again"), per_batch=64, test_count=32,
+                     seed=3)
+    first, _ = cifar10._load_batch(os.path.join(base2, "data_batch_1"))
+    np.testing.assert_array_equal(train.images[:64], first)
+
+
+def test_cli_real_data_branch_end_to_end(tmp_path, monkeypatch, capsys):
+    """The NON-synthetic orchestrator branch (cli.py's cifar10.load path)
+    end-to-end at fixture scale: generate an archive, train 2 epochs via
+    the real CLI body, get the reference report prints (VERDICT r4 weak
+    #1 — before this, every CI e2e run took the --synthetic branch)."""
+    from ddp_tpu import cli
+    from make_fake_cifar import generate
+
+    generate(str(tmp_path / "data"), per_batch=32, test_count=32, seed=1)
+    monkeypatch.chdir(tmp_path)
+    args = cli.build_parser("t").parse_args(
+        ["2", "100", "--batch_size", "8", "--model", "deepnn",
+         "--lr", "0.05", "--num_devices", "2",
+         "--data_root", str(tmp_path / "data"),
+         "--snapshot_path", str(tmp_path / "ck.pt")])
+    acc = cli.run(args, num_devices=None)
+    out = capsys.readouterr().out
+    assert "Total training time:" in out
+    assert "fp32 model has accuracy=" in out
+    assert 0.0 <= acc <= 100.0
+    # 160 train rows / (8x2) global batch = 10 steps per epoch.
+    assert "Steps: 10" in out
 
 
 def test_load_download_and_extract(tmp_path):

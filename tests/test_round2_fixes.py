@@ -73,24 +73,24 @@ def test_async_save_failure_aborts_coordinator_multihost(monkeypatch,
     tear down the coordination service (so ranks 1+ fail fast) before
     re-raising — not leave the peers hanging in the next collective."""
     t = _trainer_with_failed_save(OSError("disk full"))
-    shutdowns = []
+    aborts = []
     monkeypatch.setattr(jax, "process_count", lambda: 2)
-    monkeypatch.setattr(dist, "shutdown", lambda: shutdowns.append(1))
+    monkeypatch.setattr(dist, "abort", lambda: aborts.append(1))
     with pytest.raises(OSError, match="disk full"):
         t._join_pending_save()
-    assert shutdowns == [1]
+    assert aborts == [1]
     assert "FATAL" in capsys.readouterr().err
 
 
 def test_async_save_failure_single_host_just_raises(monkeypatch, capsys):
     """Single-host keeps the plain behavior: raise, no coordinator calls."""
     t = _trainer_with_failed_save(OSError("disk full"))
-    shutdowns = []
+    aborts = []
     monkeypatch.setattr(jax, "process_count", lambda: 1)
-    monkeypatch.setattr(dist, "shutdown", lambda: shutdowns.append(1))
+    monkeypatch.setattr(dist, "abort", lambda: aborts.append(1))
     with pytest.raises(OSError, match="disk full"):
         t._join_pending_save()
-    assert not shutdowns and "FATAL" not in capsys.readouterr().err
+    assert not aborts and "FATAL" not in capsys.readouterr().err
 
 
 def test_console_entry_points(monkeypatch):

@@ -202,7 +202,10 @@ def test_process_min_mib_int32_safe():
     mesh = make_mesh(2)
     for bytes_in, want in [(16 * 2 ** 30, 16 * 2 ** 30),   # 16 GiB exact
                            (2 ** 34 + 5 * 2 ** 20, 2 ** 34 + 5 * 2 ** 20),
-                           (123, 0),                        # sub-MiB floors
+                           # sub-MiB ceils: a tiny nonzero capacity must
+                           # stay nonzero, or the resident guard flips
+                           # from advisory to unconditional (ADVICE r4)
+                           (123, 2 ** 20),
                            (None, None)]:
         assert process_min_mib(mesh, bytes_in) == want
 

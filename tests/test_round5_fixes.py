@@ -1,0 +1,97 @@
+"""Round-5 hardening: exception-safe multi-host teardown in cli.run
+(VERDICT r4 weak #5 / next-round #4) and the three round-4 advisor lows
+(bench MFU denominator, process_min_mib zero-floor, --candidates typo)."""
+import jax
+import pytest
+
+from ddp_tpu import cli
+from ddp_tpu.parallel import dist
+
+
+def _parse(tmp_path, *extra):
+    return cli.build_parser("t").parse_args(
+        ["1", "100", "--batch_size", "4", "--synthetic", "--model",
+         "deepnn", "--synthetic_size", "16", "--num_devices", "1",
+         "--snapshot_path", str(tmp_path / "none.pt"), *extra])
+
+
+def test_run_exception_aborts_coordinator_multihost(tmp_path, monkeypatch,
+                                                    capsys):
+    """An exception anywhere in the run body on one process of a
+    multi-host run must tear down the coordination service (so peers fail
+    fast in their next collective) before re-raising — the same abort the
+    async-save path performs (trainer._join_pending_save)."""
+    calls = []
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setattr(dist, "shutdown", lambda: calls.append("shutdown"))
+    monkeypatch.setattr(dist, "abort", lambda: calls.append("abort"))
+    monkeypatch.setattr(cli, "_hard_exit",
+                        lambda code: calls.append(("exit", code)))
+
+    def boom(args, *, num_devices):
+        raise RuntimeError("eval exploded")
+
+    monkeypatch.setattr(cli, "_run_body", boom)
+    with pytest.raises(RuntimeError, match="eval exploded"):
+        cli.run(_parse(tmp_path), num_devices=1)
+    # abort BEFORE the hard exit; the raise is only reachable in tests
+    # (the real _hard_exit is os._exit — interpreter finalization blocks
+    # on the peers' collective state, measured in round 5).
+    assert calls == ["abort", ("exit", 1)]
+    assert "FATAL" in capsys.readouterr().err
+
+
+def test_run_exception_single_host_just_raises(tmp_path, monkeypatch,
+                                               capsys):
+    """Single-host keeps the plain behavior: raise, no coordinator calls
+    (there is no peer to unblock, and an abort would tear down state the
+    caller may still own — e.g. the test harness's own backend)."""
+    calls = []
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    monkeypatch.setattr(dist, "shutdown", lambda: calls.append("shutdown"))
+    monkeypatch.setattr(dist, "abort", lambda: calls.append("abort"))
+    monkeypatch.setattr(
+        cli, "_run_body",
+        lambda args, *, num_devices: (_ for _ in ()).throw(
+            RuntimeError("eval exploded")))
+    with pytest.raises(RuntimeError, match="eval exploded"):
+        cli.run(_parse(tmp_path), num_devices=1)
+    assert calls == [] and "FATAL" not in capsys.readouterr().err
+
+
+def test_mfu_gated_on_measured_device_kind():
+    """ADVICE r4: "mfu" must only be emitted against a peak MEASURED for
+    the device kind actually running — an unknown accelerator must omit
+    the field, not silently divide by another chip's denominator."""
+    import bench
+    assert bench.PEAK_TFLOPS_BF16_PASS.get("TPU v5 lite") == 197.0
+    assert bench.PEAK_TFLOPS_BF16_PASS.get(
+        jax.devices()[0].device_kind) is None  # CPU test mesh: no peak
+
+
+def test_conv_candidates_typo_is_usage_error(monkeypatch, capsys):
+    """ADVICE r4: a typo in --candidates must argparse-error with the
+    valid names, not KeyError."""
+    import sys
+
+    from ddp_tpu.ops import conv_candidates
+    monkeypatch.setattr(sys, "argv",
+                        ["prog", "--candidates", "emitter,typo_kernel"])
+    with pytest.raises(SystemExit) as exc:
+        conv_candidates.main()
+    assert exc.value.code == 2  # argparse usage error, not a traceback
+    err = capsys.readouterr().err
+    assert "typo_kernel" in err and "valid:" in err
+
+
+def test_run_success_still_shuts_down(tmp_path, monkeypatch):
+    """The success path keeps the reference teardown order: one
+    dist.shutdown() after the accuracy print (multigpu.py:250)."""
+    calls = []
+    monkeypatch.setattr(dist, "shutdown", lambda: calls.append("shutdown"))
+    monkeypatch.setattr(dist, "abort", lambda: calls.append("abort"))
+    monkeypatch.setattr(cli, "_run_body",
+                        lambda args, *, num_devices: 12.5)
+    assert cli.run(_parse(tmp_path), num_devices=1) == 12.5
+    assert calls == ["shutdown"]
