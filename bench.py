@@ -58,6 +58,7 @@ from ddp_tpu.data import synthetic
 from ddp_tpu.models import get_model
 from ddp_tpu.optim import SGDConfig, triangular_lr
 from ddp_tpu.parallel import make_mesh
+from ddp_tpu.parallel.mesh import scan_unroll
 from ddp_tpu.train import make_train_step, shard_batch
 from ddp_tpu.train.step import init_train_state
 
@@ -302,7 +303,11 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         def body(st, _):
             st, loss = step_fn(st, batch, rng)
             return st, loss
-        state, losses = jax.lax.scan(body, state, None, length=args.steps)
+        # scan_unroll: XLA:CPU compiles conv-in-while-loop to a naive
+        # fallback (~30x; parallel/mesh.py) — unroll short CPU-mesh windows
+        # (driver-contract tests, --sweep_platform cpu); TPU stays rolled.
+        state, losses = jax.lax.scan(body, state, None, length=args.steps,
+                                     unroll=scan_unroll(mesh, args.steps))
         return state, losses[-1]
 
     def scan_window():

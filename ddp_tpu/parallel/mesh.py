@@ -44,6 +44,39 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+_SCAN_UNROLL_CAP = 32
+
+
+def scan_unroll(mesh: Optional[Mesh] = None, length: Optional[int] = None):
+    """Unroll factor for ``lax.scan`` loops whose body contains model
+    compute (epoch scans, micro-batch accumulation): full unroll on the
+    CPU backend for short scans, rolled scan everywhere else.
+
+    XLA:CPU compiles convolutions inside while-loop bodies to a naive
+    serial fallback instead of its fast runtime kernels: the identical
+    8-step DeepNN train epoch measured 20.7 s rolled vs 0.6 s fully
+    unrolled on this image's jaxlib (and the unrolled program also
+    *compiles* 5x faster, 4.9 s vs 25.6 s — compiling conv-in-loop is
+    itself pathological).  Only a full unroll helps; ``unroll=4`` still
+    leaves a while loop and stays slow.  The CPU backend normally runs
+    the virtual-device test mesh and the driver's multi-chip dryrun,
+    whose epochs are a few steps; ``length`` (the static scan length,
+    known at trace time) caps the policy so a genuinely long CPU scan —
+    a real 98-step CIFAR epoch on a CPU-only box — keeps the rolled
+    program instead of compiling 98 inlined fwd+bwd bodies.  On TPU the
+    rolled scan is always right: compile time stays independent of epoch
+    length and the loop costs nothing (BASELINE.md round-4 dispatch
+    measurements).
+    """
+    platform = (mesh.devices.flat[0].platform if mesh is not None
+                else jax.default_backend())
+    if platform != "cpu":
+        return 1
+    if length is not None and length > _SCAN_UNROLL_CAP:
+        return 1
+    return True
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) axis split across ``data`` — the analogue of
     ``DistributedSampler`` handing each rank its shard (multigpu.py:153)."""

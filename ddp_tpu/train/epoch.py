@@ -34,7 +34,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
-from ..parallel.mesh import DATA_AXIS, replicated_sharding
+from ..parallel.mesh import DATA_AXIS, replicated_sharding, scan_unroll
 from .step import (TrainState, _as_input, make_accum_scan, make_group_step,
                    make_group_update, make_loss_and_grads, make_single_micro,
                    micro_from_table)
@@ -67,7 +67,7 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
                                                device_augment)),
             update)
         return lax.scan(lambda st, idx_row: group(st, idx_row, rng),
-                        state, idx)
+                        state, idx, unroll=scan_unroll(mesh, idx.shape[0]))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
@@ -104,15 +104,21 @@ def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
     compiles once.
     """
     accum = make_accum_scan(make_loss_and_grads(
-        model, compute_dtype=compute_dtype, sync_bn=sync_bn))
+        model, compute_dtype=compute_dtype, sync_bn=sync_bn),
+        unroll_fn=lambda n: scan_unroll(mesh, n))
     update = make_group_update(sgd_config, lr_schedule)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         get_micro = micro_from_table(images, labels, device_augment)
         group = make_group_step(
             lambda p, s, xs, g: accum(p, s, xs, get_micro, g), update)
+        # Nested unrolls multiply: bound the outer unroll by the PRODUCT
+        # G*A of inlined bodies (the inner accum scan unrolls A of them
+        # per group), not by G alone.
         return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
-                        state, idx)
+                        state, idx,
+                        unroll=scan_unroll(mesh,
+                                           idx.shape[0] * idx.shape[1]))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
@@ -154,7 +160,9 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
         # varying over ``data`` or its in/out vma types won't match.
         init = jax.lax.pcast((jnp.zeros(()), jnp.zeros(())), DATA_AXIS,
                              to="varying")
-        (correct, total), _ = lax.scan(one_step, init, (idx, mask))
+        (correct, total), _ = lax.scan(one_step, init, (idx, mask),
+                                       unroll=scan_unroll(mesh,
+                                                          idx.shape[0]))
         return lax.psum(correct, DATA_AXIS), lax.psum(total, DATA_AXIS)
 
     mapped = jax.shard_map(

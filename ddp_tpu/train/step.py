@@ -39,6 +39,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
 from ..parallel.mesh import (DATA_AXIS, assemble_from_local, batch_sharding,
+                             scan_unroll,
                              replicated_sharding)
 
 
@@ -157,7 +158,7 @@ def make_single_micro(loss_and_grads, get_micro):
     return group_grads
 
 
-def make_accum_scan(loss_and_grads):
+def make_accum_scan(loss_and_grads, unroll_fn=None):
     """The shared micro-batch accumulation scaffold — ONE implementation of
     the inner scan that every ``grad_accum`` variant uses (streaming /
     resident x replicated / sharded update), so the accumulation semantics
@@ -166,7 +167,11 @@ def make_accum_scan(loss_and_grads):
 
     ``loss_and_grads(params, stats, images, labels, rng) -> (loss, stats,
     grads)`` is the per-micro forward/backward
-    (:func:`make_loss_and_grads` or the zero path's local-grads core).
+    (:func:`make_loss_and_grads` or the zero path's local-grads core);
+    ``unroll_fn(length) -> unroll`` is the scan-unroll policy for the
+    inner scan (callers pass ``lambda n: scan_unroll(mesh, n)`` —
+    :func:`~ddp_tpu.parallel.mesh.scan_unroll` — so the
+    CPU-backend cap lives in one place).
     Returns ``accum(params, stats, xs, get_micro, rng) -> (new_stats,
     grads, loss)`` where ``xs`` is the scanned micro-batch stack (any
     pytree with leading axis A), ``get_micro(aug_rng, micro_xs) ->
@@ -191,7 +196,8 @@ def make_accum_scan(loss_and_grads):
         zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
         (new_stats, gsum, lsum, _), _ = lax.scan(
             one_micro, (stats0, zeros, jnp.zeros(()),
-                        jnp.zeros((), jnp.int32)), xs)
+                        jnp.zeros((), jnp.int32)), xs,
+            unroll=unroll_fn(a) if unroll_fn is not None else 1)
         grads = jax.tree_util.tree_map(lambda g: g / a, gsum)
         return new_stats, grads, lsum / a
 
@@ -289,7 +295,8 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
     ``loss`` is the mean of the micro-batch global-mean losses.
     """
     accum = make_accum_scan(make_loss_and_grads(
-        model, compute_dtype=compute_dtype, sync_bn=sync_bn))
+        model, compute_dtype=compute_dtype, sync_bn=sync_bn),
+        unroll_fn=lambda n: scan_unroll(mesh, n))
     get_micro = _micro_from_batch(device_augment)
     _shard_body = make_group_step(
         lambda p, s, xs, rng: accum(p, s, xs, get_micro, rng),

@@ -54,7 +54,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
-from ..parallel.mesh import DATA_AXIS, replicated_sharding
+from ..parallel.mesh import DATA_AXIS, replicated_sharding, scan_unroll
 from .step import (TrainState, _as_input, _micro_from_batch,
                    make_accum_scan, make_group_step, make_single_micro,
                    micro_from_table)
@@ -235,7 +235,8 @@ def make_train_step_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
     reduce-scatter + sharded SGD + all-gather."""
     R = mesh.devices.size
     accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
-                                              sync_bn))
+                                              sync_bn),
+                            unroll_fn=lambda n: scan_unroll(mesh, n))
     zero_update = _make_zero_update(sgd_config, lr_schedule, R)
     get_micro = _micro_from_batch(device_augment)
     _shard_body = make_group_step(
@@ -272,7 +273,7 @@ def make_train_epoch_zero(model, sgd_config: sgd_lib.SGDConfig,
                           micro_from_table(images, labels, device_augment)),
             zero_update)
         return lax.scan(lambda st, idx_row: group(st, idx_row, rng),
-                        state, idx)
+                        state, idx, unroll=scan_unroll(mesh, idx.shape[0]))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
@@ -295,15 +296,20 @@ def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
     update per group."""
     R = mesh.devices.size
     accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
-                                              sync_bn))
+                                              sync_bn),
+                            unroll_fn=lambda n: scan_unroll(mesh, n))
     zero_update = _make_zero_update(sgd_config, lr_schedule, R)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         get_micro = micro_from_table(images, labels, device_augment)
         group = make_group_step(
             lambda p, s, xs, g: accum(p, s, xs, get_micro, g), zero_update)
+        # Product bound G*A, as in epoch.make_train_epoch_accum: nested
+        # unrolls multiply.
         return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
-                        state, idx)
+                        state, idx,
+                        unroll=scan_unroll(mesh,
+                                           idx.shape[0] * idx.shape[1]))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,

@@ -28,8 +28,19 @@ jax.config.update("jax_platforms", "cpu")
 # the VGG train/epoch programs (~30s each on CPU); caching their serialized
 # executables roughly halves re-run time.  Safe on CPU without the AOT
 # `xla_caches` extras (those emit machine-feature-mismatch warnings here).
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
+# Set as ENV VARS (not only jax.config) so every SUBPROCESS the suite
+# spawns — jax.distributed multihost workers, CLI end-to-end runs, bench
+# children — shares the same cache: before this, those processes recompiled
+# every program on every run (~20 min of the round-4 suite's 29, measured
+# by --durations), because jax.config updates don't cross exec boundaries
+# and DDP_TPU_COMPILATION_CACHE=0 above disables the CLI's own cache.
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+# Force-assign (not setdefault): a developer's own JAX_COMPILATION_CACHE_DIR
+# must not leak CPU-compiled test executables into their user-level cache —
+# the same isolation DDP_TPU_COMPILATION_CACHE=0 enforces for the CLI.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 # Make the repo root importable regardless of pytest rootdir configuration.
