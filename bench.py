@@ -243,7 +243,7 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
             dts.append(time.perf_counter() - t0)
         return dts
 
-    def record(tag: str, dts: list) -> dict:
+    def record(tag: str, dts: list, extra: dict = None) -> dict:
         dt = min(dts)  # best window: steady-state capability (link stalls
         #               only ever subtract; the spread fields carry the
         #               honesty about how noisy the windows were)
@@ -285,6 +285,8 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         peak = PEAK_TFLOPS_BF16_PASS.get(jax.devices()[0].device_kind)
         if gflop is not None and peak is not None:
             rec["mfu"] = round(sps_chip * gflop * 1e9 / (peak * 1e12), 4)
+        if extra:
+            rec.update(extra)
         return rec
 
     def step_window():
@@ -328,6 +330,15 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
 
     step_tag = f"{args.steps}-step window, per-step dispatch"
     scan_tag = f"{args.steps}-step scan dispatch (resident-epoch mode)"
+    # Record what program SHAPE the scan flavor timed (ADVICE r5): on CPU
+    # meshes scan_unroll fully unrolls windows <= 32 steps, a different
+    # program from the rolled loop earlier rounds measured — without this
+    # marker, cross-round CPU scan-flavor comparisons silently compare
+    # rolled against unrolled.  scan_unroll=1 means rolled; N means N
+    # bodies inlined per loop iteration (== steps here: fully unrolled).
+    _su = scan_unroll(mesh, args.steps)
+    scan_extra = {"scan_unroll": args.steps if _su is True else int(_su),
+                  "scan_rolled": _su is not True and int(_su) < args.steps}
     primary_is_step = args.dispatch == "step"
     if not primary_is_step or (extras and args.profile_dir is None):
         float(scan_window())  # compile the scanned program when needed
@@ -339,11 +350,13 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         float(primary())
         jax.profiler.stop_trace()
     recs = [record(step_tag if primary_is_step else scan_tag,
-                   time_windows(primary))]
+                   time_windows(primary),
+                   extra=None if primary_is_step else scan_extra)]
     if extras and args.profile_dir is None:
         other = scan_window if primary_is_step else step_window
         recs.append(record(scan_tag if primary_is_step else step_tag,
-                           time_windows(other)))
+                           time_windows(other),
+                           extra=scan_extra if primary_is_step else None))
     return recs
 
 

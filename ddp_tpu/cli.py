@@ -135,6 +135,27 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "reference's torch state_dict checkpoint format "
                         "(reference keys for vgg/deepnn, torchvision keys "
                         "for resnet18)")
+    p.add_argument("--keep_checkpoints", default=1, type=int, metavar="N",
+                   help="Retain the newest N checkpoints: the head plus "
+                        "N-1 rotated snapshots with a sha-256 manifest "
+                        "(resilience/lineage.py); --resume falls back to "
+                        "the newest verifiable one when the head is torn. "
+                        "Default 1 = head only, the reference's "
+                        "overwrite-in-place (multigpu.py:111)")
+    p.add_argument("--on_nan", default="abort",
+                   choices=["abort", "skip", "restore"],
+                   help="Loss health policy, checked on the existing "
+                        "deferred-loss flush (zero extra D2H): abort = "
+                        "fail fast (default); skip = log and continue; "
+                        "restore = reload the last good checkpoint and "
+                        "re-seed the step RNG")
+    p.add_argument("--watchdog_secs", default=0.0, type=float, metavar="S",
+                   help="Abort the run (non-blocking dist.abort + exit "
+                        f"status 124) when no step/epoch progress happens "
+                        "for S seconds — a stalled peer then fails the job "
+                        "fast instead of riding the 300 s shutdown "
+                        "timeout.  Must exceed the worst epoch wall time "
+                        "INCLUDING compile.  0 = off (default)")
     p.add_argument("--schedule_epochs", default=None, type=int,
                    help="Pin the LR triangle's epoch span (the reference "
                         "hardcodes 20, multigpu.py:136; default: "
@@ -275,6 +296,13 @@ def _enable_compilation_cache() -> None:
     import os
     if os.environ.get("DDP_TPU_COMPILATION_CACHE", "1") == "0":
         return
+    from .utils.compat import persistent_cache_safe
+    if not persistent_cache_safe():
+        # jax-0.4.x images: deserialized XLA:CPU executables poison the
+        # heap for later torch ops (--init_from_torch/--export_torch run
+        # torch in THIS process) — compile fresh there (see
+        # utils/compat.py::persistent_cache_safe for the measurement).
+        return
     cache = os.path.join(
         os.environ.get("XDG_CACHE_HOME",
                        os.path.join(os.path.expanduser("~"), ".cache")),
@@ -308,9 +336,23 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     hard-kill discipline NCCL watchdogs use.  Single-host keeps plain
     raise semantics (there is no peer to unblock and the caller may want
     the exception)."""
+    from .resilience.preemption import (EMERGENCY_CHECKPOINT_EXIT_STATUS,
+                                        PreemptionInterrupt)
     dist.initialize()  # no-op single-host (reference ddp_setup, multigpu.py:225)
     try:
         accuracy = _run_body(args, num_devices=num_devices)
+    except PreemptionInterrupt as e:
+        # COORDINATED exit, not a failure: every host raised at the same
+        # epoch boundary (resilience/preemption.py's collective decision)
+        # with the emergency checkpoint already on disk, so the graceful
+        # shutdown barrier completes — no peer is left in a collective.
+        print(f"preempted: {e}; exiting with status "
+              f"{EMERGENCY_CHECKPOINT_EXIT_STATUS} — relaunch with "
+              "--resume to continue", file=sys.stderr)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        dist.shutdown()
+        raise SystemExit(EMERGENCY_CHECKPOINT_EXIT_STATUS)
     except BaseException as err:
         if jax.process_count() > 1:
             print(f"FATAL: process {jax.process_index()} failed with "
@@ -403,6 +445,37 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         args.metrics_path,
         tensorboard_dir=(args.tensorboard_dir
                          if jax.process_index() == 0 else None))
+    # Resilience surface (ddp_tpu/resilience/): graceful SIGTERM/SIGINT
+    # handling is on whenever we own the main thread (signal.signal is
+    # main-thread-only; embedded callers keep their own handlers), the
+    # watchdog is opt-in via --watchdog_secs.
+    import threading
+
+    from .resilience.preemption import PreemptionGuard
+    preemption = (PreemptionGuard().install()
+                  if threading.current_thread() is threading.main_thread()
+                  else None)
+    try:
+        return _run_guarded(args, preemption, metrics, model, train_loader,
+                            params, batch_stats, mesh, lr_schedule,
+                            compute_dtype, device_augment, test_ds,
+                            n_replicas, local_replicas)
+    finally:
+        # Handlers must not outlive the run even when construction (e.g. a
+        # resume with every checkpoint torn) raises before training starts
+        # — an embedding process keeps its own signal behavior.
+        if preemption is not None:
+            preemption.uninstall()
+
+
+def _run_guarded(args, preemption, metrics, model, train_loader, params,
+                 batch_stats, mesh, lr_schedule, compute_dtype,
+                 device_augment, test_ds, n_replicas, local_replicas) -> float:
+    """The trainer-lifetime tail of :func:`_run_body`, inside the
+    preemption guard's install/uninstall bracket."""
+    from .resilience.watchdog import Watchdog
+    watchdog = (Watchdog(args.watchdog_secs) if args.watchdog_secs > 0
+                else None)
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
                       lr_schedule=lr_schedule,
                       sgd_config=SGDConfig(lr=args.lr,
@@ -414,7 +487,16 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                       resume=args.resume, metrics=metrics,
                       device_augment=device_augment, resident=args.resident,
                       shard_update=args.shard_update, sync_bn=args.sync_bn,
-                      grad_accum=args.grad_accum)
+                      grad_accum=args.grad_accum,
+                      keep_checkpoints=args.keep_checkpoints,
+                      on_nan=args.on_nan,
+                      watchdog=watchdog, preemption=preemption)
+    # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
+    # — resilience/faults.py; the subprocess drills in
+    # tests/test_resilience.py drive preemption/NaN/stall through the real
+    # CLI surface this way).
+    from .resilience.faults import install_env_faults
+    install_env_faults(trainer)
 
     eval_loader = EvalLoader(test_ds, min(args.batch_size, 512), n_replicas,
                              local_replicas=local_replicas)
@@ -464,10 +546,16 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         jax.profiler.start_trace(args.profile_dir)
     try:
         try:
+            if watchdog is not None:
+                watchdog.start()  # armed for training only (its documented
+                #                   epoch/step scope; the heartbeats come
+                #                   from the trainer's loops)
             trainer.train(
                 args.total_epochs,
                 epoch_callback=_epoch_callback if args.eval_every else None)
         finally:
+            if watchdog is not None:
+                watchdog.stop()
             # Stop the trace at the end of TRAINING (its documented scope),
             # even on a mid-run failure — an un-stopped trace is empty.
             if args.profile_dir:

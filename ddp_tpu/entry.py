@@ -4,6 +4,15 @@ reference-shaped commands without needing the repo-root scripts).
 ``ddp-tpu-single`` == ``python singlegpu.py`` (mesh of 1,
 singlegpu.py:254-263); ``ddp-tpu-multi`` == ``python multigpu.py``
 (all devices, multigpu.py:254-263).  Identical argv surface.
+
+Exit-status contract (ddp_tpu/resilience/; a restart wrapper keys off it):
+  0    normal completion
+  75   preempted (SIGTERM/SIGINT): a coordinated emergency checkpoint is
+       on disk — relaunch the same command with ``--resume``
+  124  watchdog expired (``--watchdog_secs``): no step/epoch progress —
+       investigate before relaunching
+  1    a real failure (multi-host: after the non-blocking distributed
+       abort that unblocks peer processes)
 """
 from __future__ import annotations
 

@@ -91,6 +91,39 @@ def shutdown() -> None:
         _initialized = False
 
 
+# The jax._src.distributed.global_state attributes the non-blocking
+# abort() fast path drops.  Named once so the runtime check in abort(),
+# the readiness probe below, and the tier-1 canary test
+# (tests/test_resilience.py::test_abort_fast_path_canary — VERDICT r5 #3)
+# all pin the same contract: if a JAX upgrade moves these, the canary
+# fails FAST instead of every multi-host abort silently becoming a 300 s
+# graceful-shutdown hang.
+_ABORT_FAST_PATH_ATTRS = ("preemption_sync_manager", "client", "service")
+
+
+def abort_fast_path_ready() -> bool:
+    """True when the private-internals layout :func:`abort` relies on is
+    present on this JAX build (the canary's assertion)."""
+    try:
+        from jax._src import distributed as _internal
+        state = _internal.global_state
+    except Exception:
+        return False
+    return all(hasattr(state, a) for a in _ABORT_FAST_PATH_ATTRS)
+
+
+def preemption_sync_manager():
+    """The runtime's preemption sync manager (created by
+    ``jax.distributed.initialize``), or None single-host / on internal
+    layout drift — resilience/preemption.py polls it so preemption notices
+    delivered below Python join the coordinated-checkpoint decision."""
+    try:
+        from jax._src import distributed as _internal
+        return _internal.global_state.preemption_sync_manager
+    except Exception:
+        return None
+
+
 def abort() -> None:
     """NON-GRACEFUL distributed teardown for abort paths — never blocks.
 
@@ -112,7 +145,7 @@ def abort() -> None:
     try:
         from jax._src import distributed as _internal
         state = _internal.global_state
-        for attr in ("preemption_sync_manager", "client", "service"):
+        for attr in _ABORT_FAST_PATH_ATTRS:
             if not hasattr(state, attr):
                 # Plain setattr cannot fail on this class, so layout
                 # drift must be DETECTED, not absorbed — a silently

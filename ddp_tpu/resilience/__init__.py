@@ -1,0 +1,44 @@
+"""Resilience subsystem — surviving the failures real TPU pods throw.
+
+The reference has no failure story at all: a SIGTERM mid-epoch loses
+everything since the last ``save_every`` boundary (multigpu.py:117-119), a
+torn ``checkpoint.pt`` kills ``--resume`` outright, a diverged loss trains
+NaNs to completion in silence, and a stuck peer rides the full 300 s
+graceful-shutdown timeout.  This package turns "resume exists" into "runs
+survive":
+
+- ``lineage``    retained rotating snapshots with a per-file SHA-256
+                 manifest, and resume fall-back to the newest *verifiable*
+                 checkpoint when the head is torn.
+- ``preemption`` SIGTERM/SIGINT -> one coordinated emergency checkpoint at
+                 the next epoch boundary on all hosts, then a clean exit
+                 with :data:`EMERGENCY_CHECKPOINT_EXIT_STATUS`.
+- ``guard``      per-step loss health policy (``--on_nan
+                 {abort,skip,restore}``) folded into the trainer's existing
+                 deferred-loss flush — zero extra device->host transfers.
+- ``watchdog``   heartbeat thread bounding epoch/step wall time; on expiry
+                 it calls the non-blocking ``dist.abort()`` and hard-exits
+                 with :data:`WATCHDOG_EXIT_STATUS` instead of hanging peers.
+- ``faults``     test-only fault injection (tear a checkpoint, poison the
+                 loss at step k, SIGTERM at epoch k, stall a host) driving
+                 ``tests/test_resilience.py`` and the CLI drills.
+
+Exit-status contract (a restart wrapper keys off these):
+  0    normal completion
+  75   (EX_TEMPFAIL) preempted; emergency checkpoint on disk — relaunch
+       with ``--resume``
+  124  watchdog expired: no step/epoch progress within ``--watchdog_secs``
+  else a real failure; inspect before relaunching
+"""
+from .guard import NonFiniteLossError, StepHealthGuard
+from .lineage import CheckpointLineage, load_latest_verifiable
+from .preemption import (EMERGENCY_CHECKPOINT_EXIT_STATUS, PreemptionGuard,
+                         PreemptionInterrupt)
+from .watchdog import WATCHDOG_EXIT_STATUS, Watchdog
+
+__all__ = [
+    "CheckpointLineage", "EMERGENCY_CHECKPOINT_EXIT_STATUS",
+    "NonFiniteLossError", "PreemptionGuard", "PreemptionInterrupt",
+    "StepHealthGuard", "WATCHDOG_EXIT_STATUS", "Watchdog",
+    "load_latest_verifiable",
+]

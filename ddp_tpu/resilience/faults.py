@@ -1,0 +1,132 @@
+"""Test-only fault injection — the failure modes the resilience layer
+exists for, made reproducible on a laptop CPU mesh.
+
+Nothing here runs in production paths: the injectors monkeypatch a single
+``Trainer`` instance (no global state), and the one production touchpoint —
+``cli.install_env_faults`` — is a no-op unless the :data:`FAULT_ENV`
+variable is set, which only the drills in ``tests/test_resilience.py`` do.
+
+Faults:
+  ``tear_file``         truncate a checkpoint (external-damage model; the
+                        atomic saver itself never produces a torn file)
+  ``poison_loss``       replace the recorded loss at global step k with
+                        NaN, once — drives the ``--on_nan`` policies
+  ``sigterm_at_epoch``  deliver SIGTERM to this process at the end of
+                        epoch k — the preemption drill
+  ``stall_at_epoch``    put one rank to sleep at the end of epoch k — the
+                        hung-peer scenario the watchdog bounds
+
+Env surface for subprocess drills (``DDP_TPU_FAULT``): semicolon-separated
+specs ``kind@key=val,key=val`` — e.g.
+``sigterm@epoch=1``, ``poison@step=5``,
+``stall@epoch=0,rank=1,secs=600``.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+FAULT_ENV = "DDP_TPU_FAULT"
+
+
+def tear_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Truncate ``path`` to ``keep_fraction`` of its bytes (at least one
+    byte shorter) — the torn-by-external-damage checkpoint."""
+    size = os.path.getsize(path)
+    keep = min(int(size * keep_fraction), size - 1)
+    with open(path, "r+b") as f:
+        f.truncate(max(keep, 0))
+
+
+def poison_loss(trainer, step: int, value: float = float("nan")) -> None:
+    """Replace the loss recorded at global step ``step`` with ``value``,
+    ONCE (a latch: after an ``--on_nan restore`` rewinds the step counter
+    past ``step``, the fault does not re-fire — a real transient).  Hooks
+    the deferred-flush boundary, so the guard sees the poison exactly where
+    it would see a real divergence."""
+    orig = trainer._flush_losses
+    fired = [False]
+
+    def wrapped(epoch, start_step, stacked):
+        if not fired[0] and stacked is not None:
+            n = int(stacked.shape[0])
+            if start_step <= step < start_step + n:
+                arr = np.array(jax.device_get(stacked), dtype=np.float64)
+                arr[step - start_step] = value
+                stacked = arr
+                fired[0] = True
+        return orig(epoch, start_step, stacked)
+
+    trainer._flush_losses = wrapped
+
+
+def _after_epoch(trainer, fn) -> None:
+    orig = trainer._run_epoch
+
+    def wrapped(epoch):
+        orig(epoch)
+        fn(epoch)
+
+    trainer._run_epoch = wrapped
+
+
+def sigterm_at_epoch(trainer, epoch: int) -> None:
+    """Deliver SIGTERM to this process right after epoch ``epoch`` runs —
+    before the trainer's save gate and preemption check, like a real
+    preemption notice landing mid-run."""
+
+    def fire(e):
+        if e == epoch:
+            print(f"[fault] delivering SIGTERM after epoch {e}",
+                  file=sys.stderr)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _after_epoch(trainer, fire)
+
+
+def stall_at_epoch(trainer, epoch: int, seconds: float,
+                   rank: Optional[int] = None) -> None:
+    """Sleep ``seconds`` after epoch ``epoch`` on ``rank`` (all ranks when
+    None) — a wedged host; its peers block in their next collective."""
+
+    def fire(e):
+        if e == epoch and (rank is None or jax.process_index() == rank):
+            print(f"[fault] rank {jax.process_index()} stalling "
+                  f"{seconds:.0f}s after epoch {e}", file=sys.stderr)
+            sys.stderr.flush()
+            time.sleep(seconds)
+
+    _after_epoch(trainer, fire)
+
+
+def install_env_faults(trainer) -> None:
+    """Apply :data:`FAULT_ENV` fault specs to ``trainer`` (no-op when the
+    variable is unset — the only line of this module production code
+    reaches)."""
+    spec = os.environ.get(FAULT_ENV)
+    if not spec:
+        return
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, argstr = part.partition("@")
+        kv = dict(a.split("=", 1) for a in argstr.split(",") if a)
+        if kind == "sigterm":
+            sigterm_at_epoch(trainer, int(kv["epoch"]))
+        elif kind == "poison":
+            poison_loss(trainer, int(kv["step"]),
+                        float(kv.get("value", "nan")))
+        elif kind == "stall":
+            stall_at_epoch(trainer, int(kv["epoch"]),
+                           float(kv.get("secs", "3600")),
+                           rank=int(kv["rank"]) if "rank" in kv else None)
+        else:
+            raise ValueError(f"unknown {FAULT_ENV} fault kind {kind!r} "
+                             f"in {part!r}")

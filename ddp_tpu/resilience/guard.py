@@ -1,0 +1,82 @@
+"""Step health guard — the ``--on_nan {abort,skip,restore}`` policy.
+
+Detection rides the trainer's existing deferred-loss flush: every epoch's
+per-step losses already cross device->host as one stacked transfer
+(``Trainer._flush_losses``), so checking them costs ZERO extra D2H reads —
+the reference (which never reads the loss at all, SURVEY.md §5) could not
+have this for free.  Detection is therefore *post-hoc*: the update that
+produced a non-finite loss has already been applied, and on non-save epochs
+it may surface one epoch late (the flush is deferred by design).  What the
+policies mean under that model:
+
+``abort``   (default) raise :class:`NonFiniteLossError` — fail fast, and
+            because the trainer flushes/checks an epoch's losses *before*
+            checkpointing it, the newest checkpoint on disk is always one
+            whose losses were verified finite.
+``skip``    log and keep training (the reference's implicit behavior, made
+            explicit); NaNs may persist in the parameters.
+``restore`` reload the newest verifiable checkpoint (lineage fall-back
+            included) and continue from there with a re-seeded step RNG —
+            the re-seed changes the augmentation/dropout stream so a
+            numerics-driven divergence doesn't deterministically replay.
+            Bounded by ``max_restores``; exhausting it raises.
+"""
+from __future__ import annotations
+
+import sys
+
+import numpy as np
+
+POLICIES = ("abort", "skip", "restore")
+
+
+class NonFiniteLossError(RuntimeError):
+    """Training produced a non-finite loss and the policy said stop."""
+
+
+class RestoreFromLastGood(Exception):
+    """Internal control-flow signal: ``Trainer.train`` catches this and
+    reloads the newest verifiable checkpoint (``on_nan=restore``)."""
+
+
+class StepHealthGuard:
+    def __init__(self, policy: str = "abort", max_restores: int = 8):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"on_nan policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.max_restores = int(max_restores)
+        self.restores = 0  # also the RNG re-seed counter (trainer folds it)
+
+    def check(self, losses: np.ndarray, *, epoch: int,
+              start_step: int) -> None:
+        """Apply the policy to one flushed epoch's loss vector.  Raises
+        per policy; returns normally when all losses are finite (or under
+        ``skip``)."""
+        finite = np.isfinite(losses)
+        if finite.all():
+            return
+        bad = np.flatnonzero(~finite)
+        steps = [int(start_step + i) for i in bad[:8]]
+        msg = (f"non-finite loss at epoch {epoch}, global step(s) {steps}"
+               f"{' (+more)' if len(bad) > 8 else ''} "
+               f"[{len(bad)}/{losses.size} steps affected]")
+        if self.policy == "skip":
+            print(f"WARNING: {msg}; --on_nan skip: continuing (parameters "
+                  "may carry NaNs)", file=sys.stderr)
+            sys.stderr.flush()
+            return
+        if self.policy == "restore":
+            if self.restores >= self.max_restores:
+                raise NonFiniteLossError(
+                    f"{msg}; restore budget exhausted "
+                    f"({self.restores}/{self.max_restores} restores used)")
+            self.restores += 1
+            print(f"WARNING: {msg}; --on_nan restore: reloading the last "
+                  f"good checkpoint (restore {self.restores}/"
+                  f"{self.max_restores})", file=sys.stderr)
+            sys.stderr.flush()
+            raise RestoreFromLastGood(msg)
+        raise NonFiniteLossError(
+            f"{msg}; --on_nan abort (pass --on_nan skip|restore to "
+            "continue instead)")

@@ -1,0 +1,265 @@
+"""Checkpoint lineage: retained rotating snapshots + SHA-256 manifest.
+
+The reference overwrites one fixed ``checkpoint.pt`` in place
+(multigpu.py:111) and has no load path; our ``save_checkpoint`` already
+writes atomically, so a crash mid-save never tears the head — but external
+damage (a preempted copy, a truncated upload, filesystem rot) can, and
+before this module a torn head made ``--resume`` fatal with nothing to fall
+back to.
+
+Layout (all siblings of the head path ``P``):
+  ``P``                    the head — always the newest checkpoint
+  ``P.ep<NNNNNNNN>``       rotated snapshots of former heads (hard links
+                           made *before* each overwrite, so the old inode
+                           survives ``os.replace``), newest ``keep - 1``
+  ``P.manifest.json``      per-file epoch/step/sha256/size records,
+                           written atomically after each head write
+
+Single-writer discipline: every mutator here runs inside the trainer's one
+async checkpoint writer thread (rank 0; ``Trainer._join_pending_save``
+guarantees at most one in flight), which is what makes
+preserve -> write -> commit -> trim safe without locking, and why rotation
+can never delete a file the saver is still writing — the in-flight write is
+always a ``*.tmp`` name this module never touches, and trimming happens in
+the same thread after the write has landed.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..train.checkpoint import (Checkpoint, CheckpointError, load_checkpoint,
+                                sha256_of_file)
+
+MANIFEST_SUFFIX = ".manifest.json"
+MANIFEST_FORMAT = 1
+
+
+def lineage_name(path: str, epoch: int) -> str:
+    """Rotated-snapshot name for the head state of ``epoch``."""
+    return f"{path}.ep{int(epoch):08d}"
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr)
+    sys.stderr.flush()
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """The head path's manifest, or None when absent/unparseable (a torn
+    manifest is logged and treated as missing — the files themselves are
+    still tried, so a damaged 1 KB JSON can never block a restore)."""
+    mpath = path + MANIFEST_SUFFIX
+    try:
+        with open(mpath) as f:
+            m = json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError) as e:
+        _log(f"WARNING: checkpoint manifest {mpath!r} is unreadable "
+             f"({type(e).__name__}: {e}); proceeding without sha "
+             "verification")
+        return None
+    return m if isinstance(m, dict) else None
+
+
+class CheckpointLineage:
+    """Rank-0 retention bookkeeping around one head checkpoint path."""
+
+    def __init__(self, path: str, keep: int = 1):
+        if keep < 1:
+            raise ValueError(f"keep_checkpoints must be >= 1, got {keep}")
+        self.path = path
+        self.keep = int(keep)
+        self.manifest_path = path + MANIFEST_SUFFIX
+
+    # -- write side (single writer thread) --------------------------------
+
+    def preserve_head(self) -> None:
+        """Hard-link the CURRENT head to its epoch-numbered lineage name
+        *before* the next save overwrites it — ``os.replace`` drops the old
+        inode's last name otherwise.  No-op with ``keep == 1``, with no head
+        yet, or when the head is unreadable (a torn head is not worth
+        preserving)."""
+        if self.keep < 2 or not os.path.exists(self.path):
+            return
+        epoch = self._head_epoch()
+        if epoch is None:
+            return
+        dst = lineage_name(self.path, epoch)
+        if os.path.exists(dst):
+            # A resumed run re-commits epochs: the head is the newest
+            # authority for this epoch's state, so REPLACE the old name —
+            # keeping it could leave a stale (even torn) file squatting on
+            # the epoch slot and crowd the good state out of retention.
+            try:
+                os.unlink(dst)
+            except OSError:
+                return
+        try:
+            os.link(self.path, dst)
+        except OSError:
+            try:  # filesystems without hard links (some network mounts)
+                shutil.copy2(self.path, dst)
+            except OSError as e:
+                _log(f"WARNING: could not preserve outgoing checkpoint "
+                     f"{self.path!r} as {dst!r} ({e}); retention shrinks "
+                     "by one this round")
+
+    def _head_epoch(self) -> Optional[int]:
+        # Read the epoch from the FILE, not the manifest: the answer then
+        # doubles as a tear check (a torn head fails the npz read, returns
+        # None, and is not preserved — garbage must not take an epoch
+        # slot), and it is right even when the manifest is stale/absent.
+        try:
+            with np.load(self.path) as z:
+                return int(z["meta/epoch"])
+        except Exception:
+            return None
+
+    def commit(self, *, epoch: int, step: int, sha256: str) -> None:
+        """Record the just-written head and trim retention to ``keep``
+        states (the head plus ``keep - 1`` rotated snapshots)."""
+        m = read_manifest(self.path) or {}
+        retained: List[Dict[str, Any]] = [
+            e for e in m.get("retained", []) if isinstance(e, dict)]
+        prev_head = m.get("head")
+        if isinstance(prev_head, dict) and self.keep >= 2 and \
+                "epoch" in prev_head:
+            fname = os.path.basename(
+                lineage_name(self.path, int(prev_head["epoch"])))
+            if os.path.exists(self._resolve(fname)):
+                retained.insert(0, {**prev_head, "file": fname})
+        # Dedupe by file name (a resume re-commits epochs), newest first.
+        seen: set = set()
+        retained = [e for e in retained
+                    if e.get("file") not in seen
+                    and not seen.add(e.get("file"))]
+        for dropped in retained[max(self.keep - 1, 0):]:
+            self._unlink_rotated(dropped.get("file"))
+        retained = retained[:max(self.keep - 1, 0)]
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "head": {"file": os.path.basename(self.path),
+                     "epoch": int(epoch), "step": int(step),
+                     "sha256": sha256,
+                     "size": os.path.getsize(self.path)},
+            "retained": retained,
+        }
+        d = os.path.dirname(os.path.abspath(self.manifest_path))
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(manifest, f, indent=1)
+            os.replace(tmp, self.manifest_path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def _resolve(self, fname: str) -> str:
+        return os.path.join(os.path.dirname(os.path.abspath(self.path)),
+                            fname)
+
+    def _unlink_rotated(self, fname) -> None:
+        """Delete a dropped rotation target — only ever a ``P.ep*`` sibling
+        this module created; the head and any in-flight ``*.tmp`` write are
+        structurally not candidates."""
+        if not fname or not str(fname).startswith(
+                os.path.basename(self.path) + ".ep"):
+            return
+        try:
+            os.unlink(self._resolve(str(fname)))
+        except OSError:
+            pass  # already gone — retention is best-effort
+
+
+# -- read side (every rank, at resume / on_nan-restore time) --------------
+
+
+def _candidates(path: str) -> List[Tuple[str, Optional[str]]]:
+    """(file, expected_sha) restore candidates, newest first: the head,
+    then the manifest's retained snapshots; without a manifest, a directory
+    scan of the ``P.ep*`` naming (newest epoch first)."""
+    m = read_manifest(path)
+    out: List[Tuple[str, Optional[str]]] = []
+    head_sha = None
+    if m is not None and isinstance(m.get("head"), dict):
+        head_sha = m["head"].get("sha256")
+    if os.path.exists(path):
+        out.append((path, head_sha))
+    if m is not None:
+        for e in m.get("retained", []):
+            if not isinstance(e, dict) or not e.get("file"):
+                continue
+            fp = os.path.join(os.path.dirname(os.path.abspath(path)),
+                              str(e["file"]))
+            if os.path.exists(fp):
+                out.append((fp, e.get("sha256")))
+            else:
+                _log(f"WARNING: checkpoint manifest lists {fp!r} but the "
+                     "file is gone; skipping it as a restore candidate")
+    else:
+        rotated = sorted(glob.glob(glob.escape(path) + ".ep*"), reverse=True)
+        out.extend((fp, None) for fp in rotated)
+    return out
+
+
+def load_latest_verifiable(
+        path: Optional[str]) -> Optional[Tuple[Checkpoint, str]]:
+    """Restore the newest verifiable checkpoint under head path ``path``.
+
+    Tries the head first, then each retained snapshot newest-first.  A
+    candidate whose manifest sha256 mismatches is logged and still
+    *attempted* (a stale manifest — e.g. a preemption between the head
+    write and the manifest write — must not discard a good head); a
+    candidate ``load_checkpoint`` rejects (torn/foreign file) is logged and
+    skipped.  Falling back past the head is a recoverable, loudly-logged
+    event — the behavior today's single-file resume cannot offer.
+
+    Returns ``(checkpoint, file_used)``; ``None`` when no candidate exists
+    at all (fresh training); raises :class:`CheckpointError` naming every
+    candidate tried when candidates exist but none restores.
+    """
+    if not path:
+        return None
+    cands = _candidates(path)
+    tried: List[Tuple[str, str]] = []
+    for fp, expected_sha in cands:
+        if expected_sha:
+            try:
+                actual = sha256_of_file(fp)
+            except OSError as e:
+                tried.append((fp, f"unreadable ({e})"))
+                continue
+            if actual != expected_sha:
+                _log(f"WARNING: checkpoint {fp!r} sha256 mismatch vs "
+                     "manifest (stale manifest or file damage); attempting "
+                     "restore anyway")
+        try:
+            ck = load_checkpoint(fp)
+        except FileNotFoundError:
+            tried.append((fp, "vanished before it could be read"))
+            continue
+        except CheckpointError as e:
+            tried.append((fp, str(e)))
+            _log(f"WARNING: checkpoint {fp!r} is not restorable ({e}); "
+                 "falling back to the next retained snapshot")
+            continue
+        if fp != path:
+            _log(f"WARNING: restored FALLBACK checkpoint {fp!r} "
+                 f"(epoch {ck.epoch}) — the head {path!r} was torn or "
+                 "missing")
+        return ck, fp
+    if not cands:
+        return None
+    raise CheckpointError(
+        f"no verifiable checkpoint under {path!r}; candidates tried: "
+        + "; ".join(f"{fp!r}: {why}" for fp, why in tried))

@@ -1,0 +1,154 @@
+"""Preemption handling: SIGTERM/SIGINT -> one coordinated emergency
+checkpoint at the next epoch boundary, then a clean distinct-status exit.
+
+Why the *epoch* boundary: the checkpoint format and resume semantics are
+epoch-granular (``--resume`` restarts at ``saved_epoch + 1``; the sampler
+reshuffles deterministically from ``seed + epoch`` and the step RNG is a
+pure function of the restored step counter), so an epoch-boundary emergency
+checkpoint resumes onto the *identical* trajectory an uninterrupted run
+takes — the property the preemption drill in tests/test_resilience.py pins.
+A mid-epoch snapshot would either lose the partial epoch's updates or
+double-train its batches on resume.  On the ``--resident`` path the whole
+epoch is one dispatch anyway, so the epoch boundary IS the step boundary.
+
+Multi-host coordination: the local signal flag is OR-reduced across
+processes with a tiny jitted collective over the training mesh (the same
+asymmetric-topology-safe pattern as ``mesh.process_min_mib``), so every
+host agrees on the stop epoch and runs the (collective) checkpoint
+canonicalisation + save in lockstep.  When ``jax.distributed`` created a
+preemption sync manager (it does so at initialize), its
+``reached_sync_point`` signal is polled too — that is how cloud preemption
+notices delivered below Python (the TPU pod metadata path) join the same
+epoch-boundary decision.
+
+Second-signal escape hatch: the first SIGTERM/SIGINT arms the graceful
+path and *restores the previous handler*, so a second signal kills the
+process immediately — an operator's Ctrl-C Ctrl-C still works.
+"""
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+
+# EX_TEMPFAIL: "temporary failure, retry" — the restart wrapper's cue that
+# an emergency checkpoint is on disk and a ``--resume`` relaunch will
+# continue the run.  Distinct from 0 (done), 1 (real failure), and the
+# watchdog's 124 (no progress).
+EMERGENCY_CHECKPOINT_EXIT_STATUS = 75
+
+
+class PreemptionInterrupt(BaseException):
+    """Raised by ``Trainer.train`` after the emergency checkpoint landed.
+
+    A ``BaseException`` (like ``KeyboardInterrupt``): this is not a program
+    error and must not be swallowed by ``except Exception`` recovery
+    paths.  ``cli.run`` converts it into
+    ``SystemExit(EMERGENCY_CHECKPOINT_EXIT_STATUS)``.
+    """
+
+    def __init__(self, epoch: int, path: Optional[str]):
+        self.epoch = epoch
+        self.path = path
+        super().__init__(
+            f"preempted: emergency checkpoint at epoch {epoch}"
+            + (f" in {path!r}" if path else " (checkpointing disabled)"))
+
+
+class PreemptionGuard:
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = tuple(signals)
+        self._noticed = threading.Event()
+        self._prev: dict = {}
+        self._installed = False
+
+    def install(self) -> "PreemptionGuard":
+        """Install the handlers (main thread only — ``signal.signal``
+        raises elsewhere; callers off the main thread just skip graceful
+        preemption)."""
+        if self._installed:
+            return self
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for sig, prev in self._prev.items():
+            try:
+                # None means "installed from C" (signal.getsignal contract)
+                # — we cannot re-install that from Python; default is the
+                # closest safe restoration.
+                signal.signal(sig, prev if prev is not None
+                              else signal.SIG_DFL)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def _handler(self, signum, frame) -> None:
+        self._noticed.set()
+        print(f"preemption notice ({signal.Signals(signum).name}): will "
+              "take an emergency checkpoint at the next epoch boundary and "
+              "exit with status "
+              f"{EMERGENCY_CHECKPOINT_EXIT_STATUS}; signal again to die "
+              "immediately", file=sys.stderr)
+        sys.stderr.flush()
+        # Re-arm the pre-existing behavior so a second signal is immediate.
+        prev = self._prev.get(signum)
+        try:
+            signal.signal(signum, prev if prev is not None
+                          else signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+
+    def noticed(self) -> bool:
+        """This process's local flag (signal seen, not yet coordinated)."""
+        return self._noticed.is_set()
+
+    def should_stop(self, epoch: int, mesh) -> bool:
+        """Coordinated stop decision at the ``epoch`` boundary.
+
+        Multi-host this is a COLLECTIVE — every process must call it at
+        every epoch boundary, in the same order relative to the trainer's
+        other collectives, whether or not it saw a signal locally.
+        """
+        from ..parallel import dist
+        local = self._noticed.is_set()
+        mgr = dist.preemption_sync_manager()
+        if mgr is not None:
+            try:
+                # Non-blocking; returns True on every process at the same
+                # (coordinated) counter once any task got a notice through
+                # the runtime's own channel.
+                local = bool(mgr.reached_sync_point(int(epoch))) or local
+            except Exception:
+                pass  # manager torn down mid-run: the flag path stands
+        if jax.process_count() == 1:
+            return local
+        if _process_any(mesh, local):
+            self._noticed.set()  # a peer was preempted: we stop too
+            return True
+        return False
+
+
+def _process_any(mesh, flag: bool) -> bool:
+    """OR of a per-process bool over the mesh's processes — the same
+    device-collective pattern as ``mesh.process_min_mib`` (asymmetric-
+    topology-safe, no ``process_allgather`` reshape assumptions)."""
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import (assemble_from_local, batch_sharding,
+                                 local_replica_ids, replicated_sharding)
+    vals = assemble_from_local(
+        batch_sharding(mesh),
+        np.full(len(local_replica_ids(mesh)), 1 if flag else 0, np.int32),
+        0)
+    return bool(int(jax.jit(
+        jnp.max, out_shardings=replicated_sharding(mesh))(vals)))

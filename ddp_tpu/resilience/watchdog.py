@@ -1,0 +1,100 @@
+"""Watchdog — bound epoch/step wall time instead of hanging with the peers.
+
+The failure this exists for: one host stalls (hung collective, wedged data
+source, a peer that died without tearing down the rendezvous) and every
+other host blocks inside an XLA collective waiting for it — on the graceful
+path that ride lasts the full 300 s shutdown timeout (measured,
+parallel/dist.py:94).  The watchdog is a daemon thread fed heartbeats from
+the trainer's epoch/step loop; when no beat arrives within ``timeout_s`` it
+prints a diagnostic, calls the NON-BLOCKING ``dist.abort()`` (dropping the
+coordination-service state so peers fail fast instead of timing out), and
+hard-exits with :data:`WATCHDOG_EXIT_STATUS`.  ``os._exit`` rather than an
+exception on purpose: the main thread is typically blocked inside a C++
+collective and will never see a Python exception — the same hard-kill
+discipline NCCL watchdogs use.
+
+The thread holds no GIL dependency on the main thread's progress (blocking
+JAX calls release the GIL), so it fires even while the main thread is stuck
+in device code.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+# 124 — the conventional "timed out" status (GNU timeout(1)); distinct from
+# the preemption path's 75 so a restart wrapper can tell "resume me" from
+# "something is wedged, investigate".
+WATCHDOG_EXIT_STATUS = 124
+
+
+class Watchdog:
+    def __init__(self, timeout_s: float, *, tag: str = "train",
+                 on_expire: Optional[Callable[[], None]] = None,
+                 exit_status: int = WATCHDOG_EXIT_STATUS):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.tag = tag
+        self.on_expire = on_expire
+        self.exit_status = int(exit_status)
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._exit = os._exit  # monkeypatch seam for in-process tests
+
+    def beat(self) -> None:
+        """Record progress; cheap enough for per-step calls."""
+        self._last = time.monotonic()
+
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"watchdog-{self.tag}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        poll = min(1.0, self.timeout_s / 4.0)
+        while not self._stop.wait(poll):
+            idle = time.monotonic() - self._last
+            if idle > self.timeout_s:
+                self._expire(idle)
+                return
+
+    def _expire(self, idle: float) -> None:
+        print(f"WATCHDOG [{self.tag}]: no progress for {idle:.1f}s "
+              f"(limit {self.timeout_s:.1f}s); aborting the coordination "
+              f"service and hard-exiting {self.exit_status} so peers fail "
+              "fast instead of riding the 300 s shutdown timeout",
+              file=sys.stderr)
+        sys.stderr.flush()
+        try:
+            if self.on_expire is not None:
+                self.on_expire()
+        except Exception as e:
+            print(f"WATCHDOG [{self.tag}]: on_expire hook failed: {e!r}",
+                  file=sys.stderr)
+        try:
+            from ..parallel import dist
+            dist.abort()  # non-graceful: never blocks (dist.py)
+        finally:
+            self._exit(self.exit_status)

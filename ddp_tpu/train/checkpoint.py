@@ -17,6 +17,7 @@ reference's ``backbone.conv0.weight``-style naming from its ``add()`` helper
 """
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 from typing import Any, Dict, NamedTuple
@@ -76,11 +77,26 @@ def _unflatten(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
     return nested
 
 
+def sha256_of_file(path: str, chunk: int = 1 << 20) -> str:
+    """Streaming SHA-256 of a file — the integrity fingerprint the lineage
+    manifest records per checkpoint (resilience/lineage.py)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
 def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
-                    step: int, epoch: int) -> None:
+                    step: int, epoch: int) -> str:
     """Atomic overwrite-in-place write (the reference overwrites too,
     multigpu.py:111 — atomically here so a preempted host never leaves a
-    torn file for the other hosts to restore)."""
+    torn file for the other hosts to restore).  Returns the file's SHA-256
+    hex digest — hashed from the tmp file BEFORE the rename, so the digest
+    provably describes the bytes that became ``path``."""
     flat: Dict[str, np.ndarray] = {}
     for section, tree in zip(_SECTIONS,
                              (params, batch_stats, opt_state.momentum_buf)):
@@ -95,7 +111,9 @@ def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **flat)
+        sha = sha256_of_file(tmp)
         os.replace(tmp, path)
+        return sha
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)

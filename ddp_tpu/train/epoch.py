@@ -103,22 +103,26 @@ def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
     calls with their own ``[1, A', B']`` shapes; each distinct shape
     compiles once.
     """
-    accum = make_accum_scan(make_loss_and_grads(
-        model, compute_dtype=compute_dtype, sync_bn=sync_bn),
-        unroll_fn=lambda n: scan_unroll(mesh, n))
+    core = make_loss_and_grads(model, compute_dtype=compute_dtype,
+                               sync_bn=sync_bn)
     update = make_group_update(sgd_config, lr_schedule)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         get_micro = micro_from_table(images, labels, device_augment)
+        # Nested unrolls multiply: BOTH scans are gated on the PRODUCT G*A
+        # of inlined conv bodies, not their own lengths alone (ADVICE r5).
+        # Gating the inner scan on A only would, whenever A <= 32 < G*A,
+        # fully unroll A fwd+bwd bodies INSIDE a rolled while loop —
+        # exactly the pathological XLA:CPU conv-in-rolled-loop shape
+        # scan_unroll exists to avoid.  Product-gated, the two scans are
+        # always rolled/unrolled together.
+        total = idx.shape[0] * idx.shape[1]
+        accum = make_accum_scan(core,
+                                unroll_fn=lambda _a: scan_unroll(mesh, total))
         group = make_group_step(
             lambda p, s, xs, g: accum(p, s, xs, get_micro, g), update)
-        # Nested unrolls multiply: bound the outer unroll by the PRODUCT
-        # G*A of inlined bodies (the inner accum scan unrolls A of them
-        # per group), not by G alone.
         return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
-                        state, idx,
-                        unroll=scan_unroll(mesh,
-                                           idx.shape[0] * idx.shape[1]))
+                        state, idx, unroll=scan_unroll(mesh, total))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,

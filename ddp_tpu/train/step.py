@@ -41,6 +41,7 @@ from ..ops.losses import cross_entropy_sum_count
 from ..parallel.mesh import (DATA_AXIS, assemble_from_local, batch_sharding,
                              scan_unroll,
                              replicated_sharding)
+from ..utils.compat import vma_semantics
 
 
 def _as_input(x: jax.Array, compute_dtype=None) -> jax.Array:
@@ -78,13 +79,17 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
             # sync_bn: BN statistics psum'd over the global batch — the
             # SyncBatchNorm the reference leaves commented out
             # (multigpu.py:127), as an opt-in (ops/layers.py:bn_sync_axis).
-            # bn_grad_axis: this is the REPLICATED-params core, so the
-            # fused bn_relu VJP must all-reduce its scale/bias cotangents
-            # itself (custom_vjp opts out of shard_map's transpose psum);
-            # the ZeRO local-grads core deliberately leaves it unset.
+            # bn_grad_axis: this is the REPLICATED-params core, so under
+            # jax>=0.9 the fused bn_relu VJP must all-reduce its
+            # scale/bias cotangents itself (custom_vjp opts out of
+            # shard_map's vma transpose psum); the ZeRO local-grads core
+            # deliberately leaves it unset.  On a shimmed 0.4.x runtime
+            # (utils/compat.py) the transpose machinery reduces custom_vjp
+            # cotangents too, so the explicit psum must be OFF or γ/β
+            # grads come back mesh-size-times too large.
             from ..ops.layers import bn_grad_axis, bn_sync_axis
             with bn_sync_axis(DATA_AXIS if sync_bn else None), \
-                    bn_grad_axis(DATA_AXIS):
+                    bn_grad_axis(DATA_AXIS if vma_semantics() else None):
                 logits, new_stats = model.apply(
                     params, batch_stats,
                     _as_input(images, compute_dtype), train=True,
@@ -99,13 +104,24 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
 
         (loss, new_stats), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
-        # No explicit gradient collective: differentiating w.r.t. the
-        # replicated (in_specs=P()) params makes shard_map's autodiff insert
-        # the psum over ``data`` itself (the transpose of replication —
-        # jax>=0.9 vma semantics).  That auto-psum of the global-mean loss
-        # IS DDP's bucketed all-reduce(mean) (multigpu.py:96); an explicit
-        # pmean here would double-count by the mesh size
+        # On jax>=0.9, NO explicit gradient collective: differentiating
+        # w.r.t. the replicated (in_specs=P()) params makes shard_map's
+        # autodiff insert the psum over ``data`` itself (the transpose of
+        # replication — vma semantics).  That auto-psum of the global-mean
+        # loss IS DDP's bucketed all-reduce(mean) (multigpu.py:96); an
+        # explicit pmean there would double-count by the mesh size
         # (tests/test_train_step.py pins this numerically).
+        if not vma_semantics():
+            # Shimmed 0.4.x runtime (utils/compat.py): no vma transpose
+            # exists, so the all-reduce must be explicit.  The legacy
+            # psum-in-loss transpose scales each shard's cotangent by R
+            # (the known legacy behavior train/zero.py's local objective
+            # is designed around), so the per-device grad is R x that
+            # shard's contribution to the global-mean gradient — the MEAN
+            # over shards reconstructs it exactly:
+            #   pmean_j[(R/C)·ds_j/dw] = (1/C)·Σ_j ds_j/dw.
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, DATA_AXIS), grads)
         new_stats = jax.tree_util.tree_map(
             lambda s: lax.pmean(s, DATA_AXIS), new_stats)
         return loss, new_stats, grads

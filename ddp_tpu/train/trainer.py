@@ -15,13 +15,22 @@ epoch just to print the batch size (multigpu.py:101) is not fetched, and
 ``resume=True`` restores params/BN stats/momentum/step/epoch from the
 checkpoint (the load path the reference lacks, BASELINE.json config #5).
 
+Resilience wiring (ddp_tpu/resilience/): checkpoint lineage with manifest +
+fall-back restore (``keep_checkpoints``), the ``on_nan`` loss-health policy
+folded into the deferred-loss flush, the coordinated emergency checkpoint
+on preemption (``preemption``), and watchdog heartbeats (``watchdog``).
+Invariant the save/flush ordering buys: an epoch's losses are flushed and
+health-checked BEFORE that epoch's checkpoint is written, so under
+``on_nan`` abort/restore every checkpoint on disk describes a state whose
+losses were verified finite — which is what makes ``on_nan=restore``'s
+reload-last-good sound.
+
 Throughput: batches are host-prepared one step ahead and handed to the
 device while the previous step is still running (JAX async dispatch) — the
 TPU analogue of ``pin_memory=True`` + worker prefetch (singlegpu.py:177).
 """
 from __future__ import annotations
 
-import os
 import sys
 import threading
 from typing import Callable, List, Optional
@@ -33,7 +42,7 @@ import numpy as np
 from ..optim.sgd import SGDConfig, SGDState
 from ..parallel import dist
 from ..utils.metrics import MetricsLogger
-from .checkpoint import load_checkpoint, save_checkpoint
+from .checkpoint import save_checkpoint
 from .step import TrainState, init_train_state, make_train_step
 
 
@@ -73,7 +82,11 @@ class Trainer:
                  resident: bool = False,
                  shard_update: bool = False,
                  sync_bn: bool = False,
-                 grad_accum: int = 1):
+                 grad_accum: int = 1,
+                 keep_checkpoints: int = 1,
+                 on_nan: str = "abort",
+                 watchdog=None,
+                 preemption=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -96,21 +109,45 @@ class Trainer:
         # every epoch boundary (measured 2.1 ms/step of device idle at
         # 98-step epochs before this, BASELINE.md round 4).
         self._pending_losses = None
+        # Resilience wiring (ddp_tpu/resilience/): lineage retention, loss
+        # health policy, preemption guard, watchdog heartbeats.  Imported
+        # lazily (package-cycle hygiene, same as the zero/resident paths).
+        from ..resilience.guard import StepHealthGuard
+        from ..resilience.lineage import (CheckpointLineage,
+                                          load_latest_verifiable)
+        self.lineage = (CheckpointLineage(snapshot_path,
+                                          keep=keep_checkpoints)
+                        if snapshot_path else None)
+        self._health = StepHealthGuard(on_nan)
+        self._watchdog = watchdog
+        self._preemption = preemption
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
-        if resume and snapshot_path and os.path.exists(snapshot_path):
-            ckpt = load_checkpoint(snapshot_path)
-            self.state = TrainState(
-                jax.tree_util.tree_map(jnp.asarray, ckpt.params),
-                jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
-                jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
-                jnp.asarray(ckpt.step, jnp.int32))
-            self.start_epoch = ckpt.epoch + 1
-            print(f"Resuming training from snapshot at Epoch {ckpt.epoch}")
+        if resume and snapshot_path:
+            # Lineage-aware restore: the head first, then each retained
+            # snapshot — a torn head is a recoverable, logged event, not a
+            # fatal one (fatal only when EVERY candidate is torn).
+            loaded = load_latest_verifiable(snapshot_path)
+            if loaded is not None:
+                ckpt, used = loaded
+                self.state = TrainState(
+                    jax.tree_util.tree_map(jnp.asarray, ckpt.params),
+                    jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
+                    jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
+                    jnp.asarray(ckpt.step, jnp.int32))
+                self.start_epoch = ckpt.epoch + 1
+                print(f"Resuming training from snapshot at Epoch "
+                      f"{ckpt.epoch}"
+                      + ("" if used == snapshot_path
+                         else f" (fallback snapshot {used})"))
         # Host-side mirror of state.step: reading the device scalar would
         # block on the in-flight epoch (the exact stall the deferred loss
         # read removes), and the step count per epoch is host-known.
         self._host_step = int(self.state.step)
+        # loss_history[i] corresponds to global step _history_base + i —
+        # the offset an --on_nan restore needs to truncate the discarded
+        # trajectory's entries at the rewind point.
+        self._history_base = self._host_step
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
         if shard_update:
@@ -173,6 +210,8 @@ class Trainer:
                 self.state, loss = self.train_step(
                     self.state, device_batch, self.rng)
                 epoch_losses.append(loss)
+                if self._watchdog is not None:
+                    self._watchdog.beat()
             return jnp.stack(epoch_losses) if epoch_losses else None
         # Background thread augments + device_puts ahead of the loop (the
         # pin_memory/worker analogue, singlegpu.py:177); combined with JAX
@@ -182,6 +221,8 @@ class Trainer:
             self.state, loss = self.train_step(
                 self.state, device_batch, self.rng)
             epoch_losses.append(loss)
+            if self._watchdog is not None:
+                self._watchdog.beat()
         return jnp.stack(epoch_losses) if epoch_losses else None
 
     def _epoch_losses_resident(self):
@@ -250,9 +291,20 @@ class Trainer:
     def _flush_losses(self, epoch: int, start_step: int, stacked) -> None:
         # One stacked D2H transfer for the whole epoch's losses — per-scalar
         # reads pay a link round trip each on remote-device setups.
-        losses = (np.asarray(jax.device_get(stacked)).tolist()
-                  if stacked is not None else [])
+        arr = (np.asarray(jax.device_get(stacked))
+               if stacked is not None else np.zeros(0, np.float32))
+        losses = arr.tolist()
+        if self._watchdog is not None:
+            self._watchdog.beat()
         self.loss_history.extend(losses)
+        # Loss health policy (--on_nan), checked on the array the flush
+        # ALREADY fetched — zero extra D2H.  Losses are replicated, so on
+        # multi-host every rank reaches the same verdict from its own copy
+        # and the abort/restore paths stay in lockstep.  May raise
+        # NonFiniteLossError (abort) or RestoreFromLastGood (restore,
+        # caught by train()'s loop).
+        if losses:
+            self._health.check(arr, epoch=epoch, start_step=start_step)
         if self.metrics is not None and losses:
             # One vectorised device eval of the schedule per epoch.
             lrs = jax.device_get(jax.vmap(self.lr_schedule)(
@@ -351,8 +403,19 @@ class Trainer:
 
         def write():
             try:
-                save_checkpoint(self.snapshot_path, snap_params, snap_stats,
-                                SGDState(snap_opt), step, epoch)
+                # Lineage bookkeeping brackets the head write, all inside
+                # this single writer thread (at most one in flight —
+                # _join_pending_save above), which is what lets rotation
+                # run lock-free and guarantees it never touches a file
+                # still being written: the in-flight write is a *.tmp name
+                # rotation structurally ignores (resilience/lineage.py).
+                if self.lineage is not None:
+                    self.lineage.preserve_head()
+                sha = save_checkpoint(self.snapshot_path, snap_params,
+                                      snap_stats, SGDState(snap_opt), step,
+                                      epoch)
+                if self.lineage is not None:
+                    self.lineage.commit(epoch=epoch, step=step, sha256=sha)
                 # Reference print, singlegpu.py:122.
                 print(f"Epoch {epoch} | Training checkpoint saved at "
                       f"{self.snapshot_path}")
@@ -362,26 +425,130 @@ class Trainer:
         self._save_thread = threading.Thread(target=write, daemon=True)
         self._save_thread.start()
 
+    def _restore_last_good(self) -> int:
+        """``--on_nan restore``: reload the newest verifiable checkpoint
+        (lineage fall-back included), re-seed the step RNG, and return the
+        epoch to resume from.  Runs identically on every rank (the
+        non-finite verdict came from replicated losses), so multi-host
+        stays in lockstep."""
+        from ..resilience.guard import NonFiniteLossError
+        from ..resilience.lineage import load_latest_verifiable
+        self._join_pending_save()  # let any in-flight (good) write land
+        self._pending_losses = None  # the poisoned trajectory's records
+        loaded = (load_latest_verifiable(self.snapshot_path)
+                  if self.snapshot_path else None)
+        if loaded is None:
+            raise NonFiniteLossError(
+                "--on_nan restore: no checkpoint to restore from "
+                f"(snapshot_path={self.snapshot_path!r}); nothing good was "
+                "ever saved")
+        ckpt, used = loaded
+        state = TrainState(
+            jax.tree_util.tree_map(jnp.asarray, ckpt.params),
+            jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
+            jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
+            jnp.asarray(ckpt.step, jnp.int32))
+        if self.shard_update:
+            from .zero import pytree_to_opt_shard
+            state = TrainState(state.params, state.batch_stats,
+                               pytree_to_opt_shard(
+                                   state.opt_state.momentum_buf, self.mesh),
+                               state.step)
+        self.state = state
+        self._host_step = int(ckpt.step)
+        # Drop the discarded trajectory's loss records (they include the
+        # non-finite steps) so loss_history stays one entry per global
+        # step with no NaNs and no duplicates after the replay.  The
+        # metrics JSONL is append-only, so there the replayed steps appear
+        # twice — bracketed by the restore_from_checkpoint event below;
+        # last record per step wins for consumers.
+        del self.loss_history[max(int(ckpt.step) - self._history_base, 0):]
+        # Re-seed the step RNG stream: the augmentation/dropout keys are a
+        # pure function of (rng, step), so WITHOUT this fold the rewound
+        # step counter would replay the exact trajectory that diverged.
+        self.rng = jax.random.fold_in(self.rng, self._health.restores)
+        print(f"[GPU{self.gpu_id}] restored last-good checkpoint {used} "
+              f"(epoch {ckpt.epoch}, step {ckpt.step}); re-seeded the step "
+              "RNG and resuming", file=sys.stderr)
+        if self.metrics is not None:
+            self.metrics.log_event("restore_from_checkpoint",
+                                   epoch=ckpt.epoch, step=ckpt.step,
+                                   snapshot=used,
+                                   restores=self._health.restores)
+        return ckpt.epoch + 1
+
+    def _train_one(self, epoch: int, epoch_callback) -> None:
+        if self._watchdog is not None:
+            self._watchdog.beat()
+        self._run_epoch(epoch)
+        # NB: like the reference, epoch 0 satisfies the modulo gate
+        # — snapshot_path=None disables checkpointing entirely.
+        if self.snapshot_path and epoch % self.save_every == 0:
+            # Land + health-check THIS epoch's losses before snapshotting
+            # its state: under --on_nan abort/restore a poisoned epoch then
+            # raises here and never becomes a checkpoint, so the newest
+            # file on disk is always loss-verified — the invariant the
+            # restore policy reloads against.  Costs one host sync on save
+            # epochs only; non-save boundaries keep the deferred-flush
+            # pipelining.
+            self.flush_losses()
+            self._save_checkpoint(epoch)
+        if epoch_callback is not None:
+            # NB: the epoch's losses may still be deferred here —
+            # a callback that reads loss_history/metrics calls
+            # trainer.flush_losses() itself (see its docstring;
+            # an unconditional flush would re-serialize every
+            # epoch boundary for monitored runs).
+            epoch_callback(epoch)
+        if self._preemption is not None:
+            # COLLECTIVE on multi-host (resilience/preemption.py): every
+            # rank calls it at every epoch boundary so the stop decision —
+            # and the emergency save's collective canonicalisation — run
+            # in lockstep.
+            if self._preemption.should_stop(epoch, self.mesh):
+                self._emergency_checkpoint(epoch)
+
+    def _emergency_checkpoint(self, epoch: int) -> None:
+        """Coordinated preemption exit: flush + verify the epoch's losses,
+        make sure its checkpoint is ON DISK (not just queued), and raise
+        :class:`PreemptionInterrupt` for cli.run to convert into the
+        distinct exit status."""
+        from ..resilience.preemption import PreemptionInterrupt
+        self.flush_losses()
+        if self.snapshot_path and epoch % self.save_every != 0:
+            self._save_checkpoint(epoch)  # the modulo gate didn't fire
+        self._join_pending_save()  # async write must land before we exit
+        print(f"[GPU{self.gpu_id}] preemption: emergency checkpoint for "
+              f"epoch {epoch} is on disk"
+              + (f" at {self.snapshot_path}" if self.snapshot_path
+                 else " — DISABLED (snapshot_path=None), state lost"),
+              file=sys.stderr)
+        if self.metrics is not None:
+            self.metrics.log_event("preemption_checkpoint", epoch=epoch,
+                                   step=self._host_step,
+                                   snapshot=self.snapshot_path)
+        raise PreemptionInterrupt(epoch, self.snapshot_path)
+
     def train(self, max_epochs: int, epoch_callback=None) -> None:
         """Reference ``Trainer.train`` (multigpu.py:115-119): epoch loop with
         the rank-0 ``save_every`` checkpoint gate.  ``epoch_callback(epoch)``
         runs after each epoch's checkpoint gate (used for --eval_every;
-        no reference analogue)."""
+        no reference analogue).  The loop is restartable: an
+        ``--on_nan restore`` verdict rewinds it to the reloaded
+        checkpoint's epoch instead of unwinding the run."""
+        from ..resilience.guard import RestoreFromLastGood
         try:
-            for epoch in range(self.start_epoch, max_epochs):
-                self._run_epoch(epoch)
-                # NB: like the reference, epoch 0 satisfies the modulo gate
-                # — snapshot_path=None disables checkpointing entirely.
-                if self.snapshot_path and epoch % self.save_every == 0:
-                    self._save_checkpoint(epoch)
-                if epoch_callback is not None:
-                    # NB: the epoch's losses may still be deferred here —
-                    # a callback that reads loss_history/metrics calls
-                    # trainer.flush_losses() itself (see its docstring;
-                    # an unconditional flush would re-serialize every
-                    # epoch boundary for monitored runs).
-                    epoch_callback(epoch)
-            self.flush_losses()
+            epoch = self.start_epoch
+            while epoch < max_epochs:
+                try:
+                    self._train_one(epoch, epoch_callback)
+                    epoch += 1
+                    if epoch == max_epochs:
+                        # Final flush inside the guard: a poisoned LAST
+                        # epoch still gets its policy applied.
+                        self.flush_losses()
+                except RestoreFromLastGood:
+                    epoch = self._restore_last_good()
         finally:
             # The last checkpoint write must be on disk before train()
             # returns (resume and the reference's artifact contract depend

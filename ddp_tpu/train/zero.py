@@ -295,21 +295,22 @@ def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
     :func:`~ddp_tpu.train.epoch.make_train_epoch_accum`) with one sharded
     update per group."""
     R = mesh.devices.size
-    accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
-                                              sync_bn),
-                            unroll_fn=lambda n: scan_unroll(mesh, n))
+    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
     zero_update = _make_zero_update(sgd_config, lr_schedule, R)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         get_micro = micro_from_table(images, labels, device_augment)
+        # Product bound G*A on BOTH scans, as in
+        # epoch.make_train_epoch_accum: nested unrolls multiply, and an
+        # A-only-gated inner scan could fully unroll conv bodies inside a
+        # rolled outer loop (the pathological XLA:CPU shape — ADVICE r5).
+        total = idx.shape[0] * idx.shape[1]
+        accum = make_accum_scan(local_grads,
+                                unroll_fn=lambda _a: scan_unroll(mesh, total))
         group = make_group_step(
             lambda p, s, xs, g: accum(p, s, xs, get_micro, g), zero_update)
-        # Product bound G*A, as in epoch.make_train_epoch_accum: nested
-        # unrolls multiply.
         return lax.scan(lambda st, idx_group: group(st, idx_group, rng),
-                        state, idx,
-                        unroll=scan_unroll(mesh,
-                                           idx.shape[0] * idx.shape[1]))
+                        state, idx, unroll=scan_unroll(mesh, total))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,

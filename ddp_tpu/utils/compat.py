@@ -1,0 +1,107 @@
+"""Compatibility shims for older jax runtimes (jax 0.4.x).
+
+The framework targets jax>=0.9 (pyproject.toml): top-level
+``jax.shard_map`` with the vma type system (``check_vma`` keyword,
+``jax.lax.pcast``).  Some container images pin jax 0.4.x, where shard_map
+lives at ``jax.experimental.shard_map.shard_map`` with the older
+``check_rep`` keyword and no vma types — on such a runtime every
+``jax.shard_map`` call site would raise ``AttributeError`` before a single
+step ran.  These shims install the new names on the old runtime so ONE
+codebase runs on both:
+
+- ``jax.shard_map(f, mesh=..., in_specs=..., out_specs=...,
+  check_vma=...)`` -> ``experimental.shard_map(..., check_rep=False)``.
+  ``check_rep`` is always False here: the old rep-inference cannot prove
+  the replicated ``P()`` out_specs of our train steps (it fails with
+  "replication ... can't be statically inferred" on the grad-then-update
+  program shape), while the vma system the code was written against can.
+  Correctness does not depend on the check — with ``check_rep=False`` the
+  transpose of a replicated (``P()``) input still inserts the
+  conservative gradient ``psum`` (the check only enables the *efficient*
+  transpose that elides redundant ones), and the numeric parity suite
+  (tests/test_train_step.py golden traces, torch lockstep) is the
+  backstop that this holds on any runtime the shim activates on.
+- ``jax.lax.pcast(x, axis, to=...)`` -> identity.  The cast exists to
+  satisfy the NEW type system (e.g. marking a scan carry varying before it
+  meets sharded operands, train/epoch.py:make_eval_epoch); the old runtime
+  has no vma types to satisfy, so the value itself passes through
+  unchanged.
+
+Installed idempotently at ``import ddp_tpu`` time; a no-op on jax>=0.9.
+"""
+from __future__ import annotations
+
+import jax
+
+_SHIMMED = False
+
+
+def vma_semantics() -> bool:
+    """True on jax>=0.9, where the vma type system governs shard_map
+    autodiff and a ``custom_vjp`` opts out of the automatic gradient psum
+    (so ops/layers.py's bn_relu must all-reduce its scale/bias cotangents
+    explicitly — ``bn_grad_axis``).  False when the 0.4.x shim is active:
+    there the runtime's own transpose machinery already produces
+    globally-reduced cotangents for every replicated input, custom_vjp
+    included, and the explicit psum would double-count by the mesh size
+    (measured: exactly R x on BN scale/bias, tests/test_train_step.py::
+    test_dp_mesh_exact_without_dropout)."""
+    return not _SHIMMED
+
+
+def persistent_cache_safe() -> bool:
+    """False when the 0.4.x shim is active: on that image's jaxlib,
+    executing a DESERIALIZED XLA:CPU executable corrupts the process heap.
+    Measured two ways: warm-cache runs of the torch-parity suite segfault
+    deterministically inside ``optimizer.zero_grad`` (cold compiles of the
+    identical programs are stable), and a torch-free CLI resume subprocess
+    on a warm cache died SIGSEGV after producing a NaN loss from a
+    checkpoint that restores cleanly cold.  No process on this runtime may
+    load from the persistent compilation cache — everything compiles
+    fresh."""
+    return not _SHIMMED
+
+
+def install() -> None:
+    global _SHIMMED
+    if not hasattr(jax, "shard_map"):
+        _SHIMMED = True
+        # Persistent-cache kill-switch, applied HERE so every ddp_tpu
+        # process gets it regardless of entry point: jax binds
+        # JAX_COMPILATION_CACHE_DIR into jax.config at import time, so
+        # popping the env var alone leaves the (heap-corrupting, see
+        # persistent_cache_safe) cache active in-process — both the bound
+        # config value and the env var (inherited by children) must go.
+        import os
+        os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            pass  # config knob absent on this build: nothing was bound
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kwargs):
+            del check_vma  # see module docstring: always uncheck on 0.4.x
+            return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False,
+                              **kwargs)
+
+        jax.shard_map = shard_map
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axis_name, *, to):
+            del axis_name, to
+            return x
+
+        jax.lax.pcast = pcast
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            # psum of the Python constant 1 is constant-folded to the
+            # static axis size on 0.4.x (verified int, not a tracer) —
+            # exactly what the new jax.lax.axis_size returns.
+            return jax.lax.psum(1, axis_name)
+
+        jax.lax.axis_size = axis_size
+
+
+install()

@@ -56,6 +56,17 @@ class MetricsLogger:
                 self._tf.summary.scalar("train/loss", loss, step=step)
                 self._tf.summary.scalar("train/lr", lr, step=step)
 
+    def log_event(self, kind: str, **fields) -> None:
+        """Resilience/lifecycle event record (preemption checkpoint,
+        fallback restore, non-finite loss, watchdog) — JSONL only; these
+        are discrete events, not scalar curves, so no TensorBoard mirror.
+        One line per event: ``{"event": kind, ...fields, "wall_s": t}``."""
+        if self._f is not None:
+            self._f.write(json.dumps({
+                "event": kind, **fields,
+                "wall_s": round(time.time() - self._t0, 3),
+            }) + "\n")
+
     def log_eval(self, *, epoch: int, accuracy: float,
                  final: bool = False) -> None:
         """Eval-accuracy record: periodic (--eval_every) or, with

@@ -29,6 +29,10 @@ config #5).
 assert periodic-eval prints/records are rank-0-gated across real processes.
 ``cli_evalfail`` is ``cli`` with an exception injected into process 1's
 final eval (cli.run's distributed-abort guard must unblock process 0).
+``cli_watchdog`` is ``cli`` with ``--watchdog_secs 15`` and more epochs —
+the spawning test stalls one rank via ``DDP_TPU_FAULT`` so the OTHER
+rank's watchdog must fire (exit 124) well under the 300 s shutdown
+timeout (tests/test_resilience.py).
 
 Topology comes from the spawning test: ``MH_NUM_PROCESSES`` processes and
 ``MH_LOCAL_DEVICES`` devices per process — either one count shared by all
@@ -71,7 +75,7 @@ def main() -> None:
     assert jax.process_count() == _NUM_PROCESSES
     assert jax.device_count() == _TOTAL_DEVICES
 
-    if mode in ("cli", "cli_evalfail"):
+    if mode in ("cli", "cli_evalfail", "cli_watchdog"):
         # Full CLI path on 2 real processes: the periodic eval is a
         # collective every process must run, but its print + JSONL record
         # must come from rank 0 only (VERDICT weak #4).  dist.initialize
@@ -87,6 +91,12 @@ def main() -> None:
         if mode == "cli":
             argv += ["--eval_every", "1",
                      "--metrics_path", ckpt_path + ".metrics.jsonl"]
+        elif mode == "cli_watchdog":
+            # 4 epochs so the non-stalled rank has collectives left to
+            # block in after the DDP_TPU_FAULT stall; the fault env is set
+            # by the spawning test (rank-gated inside faults.py).
+            argv[0] = "4"
+            argv += ["--watchdog_secs", "15"]
         elif pid == 1:
             def _boom(*a, **k):
                 raise RuntimeError("injected eval failure")

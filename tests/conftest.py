@@ -24,6 +24,13 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Make the repo root importable regardless of pytest rootdir configuration
+# (before the ddp_tpu import below).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ddp_tpu  # noqa: E402,F401  (installs the utils/compat.py jax shims)
+from ddp_tpu.utils.compat import persistent_cache_safe  # noqa: E402
+
 # Persistent compilation cache: the suite is dominated by XLA compiles of
 # the VGG train/epoch programs (~30s each on CPU); caching their serialized
 # executables roughly halves re-run time.  Safe on CPU without the AOT
@@ -34,17 +41,26 @@ jax.config.update("jax_platforms", "cpu")
 # every program on every run (~20 min of the round-4 suite's 29, measured
 # by --durations), because jax.config updates don't cross exec boundaries
 # and DDP_TPU_COMPILATION_CACHE=0 above disables the CLI's own cache.
-_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
-# Force-assign (not setdefault): a developer's own JAX_COMPILATION_CACHE_DIR
-# must not leak CPU-compiled test executables into their user-level cache —
-# the same isolation DDP_TPU_COMPILATION_CACHE=0 enforces for the CLI.
-os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
-os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
-jax.config.update("jax_compilation_cache_dir", _cache_dir)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-
-# Make the repo root importable regardless of pytest rootdir configuration.
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+#
+# EXCEPT on jax-0.4.x images (the compat-shim runtime): there, executing a
+# deserialized XLA:CPU executable corrupts the process heap — measured as
+# deterministic segfaults in torch ops after warm-cache jax runs AND as a
+# SIGSEGV+NaN in a torch-free warm-cache CLI subprocess — so no process
+# (this one or any child) may use the cache; everything compiles fresh
+# (compat.persistent_cache_safe has the details).
+if persistent_cache_safe():
+    _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+    # Force-assign (not setdefault): a developer's own
+    # JAX_COMPILATION_CACHE_DIR must not leak CPU-compiled test executables
+    # into their user-level cache — the same isolation
+    # DDP_TPU_COMPILATION_CACHE=0 enforces for the CLI.
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _cache_dir
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "1.0"
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+else:
+    # Don't let an outer environment leak a poisoned cache into children.
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import pytest  # noqa: E402
 
