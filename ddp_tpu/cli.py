@@ -101,9 +101,28 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Also mirror the per-step loss/LR (and periodic "
                         "eval accuracy) as TensorBoard scalars into this "
                         "directory (rank 0; needs tensorflow)")
-    p.add_argument("--device_augment", action="store_true",
+    p.add_argument("--device_augment", "--augment_device",
+                   action="store_true",
                    help="Run RandomCrop+HFlip on the TPU inside the train "
-                        "step instead of on the host (same distribution)")
+                        "step instead of on the host (same distribution): "
+                        "the host ships raw uint8 once and the crop/flip "
+                        "cost moves onto the chip (data/device_augment.py)")
+    p.add_argument("--prefetch_depth", default=2, type=int, metavar="D",
+                   help="Streaming input engine (data/prefetch.py): keep "
+                        "up to D prepared batches in flight beyond the "
+                        "augment workers' hands (bounded queue), so host "
+                        "augment, H2D and compute pipeline.  0 disables "
+                        "the overlap — materialise + upload inline, the "
+                        "reference's serial loop shape (singlegpu.py:"
+                        "104-107).  Default 2 (the established behavior; "
+                        "the batch stream is bit-identical at every "
+                        "setting — tests/test_prefetch.py)")
+    p.add_argument("--prefetch_workers", default=4, type=int, metavar="W",
+                   help="Concurrent host materialise/augment workers "
+                        "feeding the streaming path (default 4; only "
+                        "applies to random-access loaders — the "
+                        "accumulation group stream pipelines on one "
+                        "thread)")
     p.add_argument("--resident", action="store_true",
                    help="Keep the whole dataset resident in HBM and run "
                         "each epoch as one jitted lax.scan: no per-step "
@@ -490,7 +509,9 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       grad_accum=args.grad_accum,
                       keep_checkpoints=args.keep_checkpoints,
                       on_nan=args.on_nan,
-                      watchdog=watchdog, preemption=preemption)
+                      watchdog=watchdog, preemption=preemption,
+                      prefetch_depth=args.prefetch_depth,
+                      prefetch_workers=args.prefetch_workers)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real

@@ -1,11 +1,12 @@
 from .augment import random_crop_flip, to_float
 from .cifar10 import Dataset, load, synthetic
 from .loader import EvalLoader, TrainLoader
+from .prefetch import PrefetchStats, prefetch_to_device
 from .resident import ResidentData
 from .sampler import DistributedShardSampler, ShuffleSampler
 
 __all__ = [
-    "Dataset", "DistributedShardSampler", "EvalLoader", "ResidentData",
-    "ShuffleSampler", "TrainLoader", "load", "random_crop_flip", "synthetic",
-    "to_float",
+    "Dataset", "DistributedShardSampler", "EvalLoader", "PrefetchStats",
+    "ResidentData", "ShuffleSampler", "TrainLoader", "load",
+    "prefetch_to_device", "random_crop_flip", "synthetic", "to_float",
 ]

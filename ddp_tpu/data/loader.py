@@ -59,6 +59,13 @@ class TrainLoader:
             self.samplers = [ShuffleSampler(len(dataset), shuffle=shuffle,
                                             seed=seed)]
         self.steps_per_epoch = -(-len(self.samplers[0]) // per_replica_batch)
+        import threading
+        # The prefetch pool (data/prefetch.py) calls materialize() from
+        # several workers at once; the lazy per-epoch shard build must
+        # happen exactly once (it is idempotent — pure function of
+        # (seed, epoch) — but N workers each permuting a 50k-index array
+        # is N-1 wasted shuffles at every epoch boundary).
+        self._shards_lock = threading.Lock()
 
     def set_epoch(self, epoch: int) -> None:
         """Reference ``sampler.set_epoch`` (multigpu.py:103)."""
@@ -88,7 +95,9 @@ class TrainLoader:
 
     def _epoch_shards(self):
         if getattr(self, "_shards", None) is None:
-            self._shards = [s.indices() for s in self.samplers]
+            with self._shards_lock:
+                if getattr(self, "_shards", None) is None:
+                    self._shards = [s.indices() for s in self.samplers]
         return self._shards
 
     def materialize(self, k: int) -> Dict[str, np.ndarray]:

@@ -86,7 +86,10 @@ class Trainer:
                  keep_checkpoints: int = 1,
                  on_nan: str = "abort",
                  watchdog=None,
-                 preemption=None):
+                 preemption=None,
+                 prefetch_depth: int = 2,
+                 prefetch_workers: int = 4,
+                 prefetch_stats=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -150,6 +153,15 @@ class Trainer:
         self._history_base = self._host_step
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
+        # Streaming overlap engine knobs (data/prefetch.py): how many
+        # batches may be in flight beyond the worker pool's hands, and how
+        # many materialise/augment workers run.  depth=0 disables the
+        # overlap (bit-identical stream — tests/test_prefetch.py pins it).
+        # prefetch_stats (opt-in PrefetchStats) feeds the streaming-gap
+        # attribution (bench.py --stream_attr, BASELINE.md round 6).
+        self.prefetch_depth = prefetch_depth
+        self.prefetch_workers = prefetch_workers
+        self.prefetch_stats = prefetch_stats
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
             # lives as one flat array sharded over ``data`` (1/R per chip).
@@ -200,24 +212,30 @@ class Trainer:
         """Per-step dispatch over host-fed batches (the reference's loop,
         multigpu.py:104-107)."""
         epoch_losses = []
-        if self.grad_accum > 1:
-            # One dispatch per GROUP of grad_accum micro-batches; the
-            # scanned accumulation amortises the per-dispatch overhead A-x,
-            # so no prefetch thread is needed here.
-            from .step import shard_batch_stacked
-            for group in _stack_groups(self.train_loader, self.grad_accum):
-                device_batch = shard_batch_stacked(group, self.mesh)
-                self.state, loss = self.train_step(
-                    self.state, device_batch, self.rng)
-                epoch_losses.append(loss)
-                if self._watchdog is not None:
-                    self._watchdog.beat()
-            return jnp.stack(epoch_losses) if epoch_losses else None
-        # Background thread augments + device_puts ahead of the loop (the
-        # pin_memory/worker analogue, singlegpu.py:177); combined with JAX
-        # async dispatch the chips never wait on the host in steady state.
         from ..data.prefetch import prefetch_to_device
-        for device_batch in prefetch_to_device(self.train_loader, self.mesh):
+        if self.grad_accum > 1:
+            # One dispatch per GROUP of grad_accum micro-batches.  The
+            # scanned accumulation amortises the per-dispatch overhead A-x;
+            # the threaded prefetcher still pipelines group materialisation
+            # + H2D against the (A-x longer) group dispatch, at the same
+            # depth knob.  _stack_groups is a plain iterable, so this takes
+            # the single-thread path; the stacked sharding rides in via
+            # shard_fn.
+            from .step import shard_batch_stacked
+            batches = prefetch_to_device(
+                _stack_groups(self.train_loader, self.grad_accum),
+                self.mesh, depth=self.prefetch_depth,
+                workers=self.prefetch_workers, stats=self.prefetch_stats,
+                shard_fn=shard_batch_stacked)
+        else:
+            # Worker pool augments + device_puts ahead of the loop (the
+            # pin_memory/worker analogue, singlegpu.py:177); combined with
+            # JAX async dispatch the chips never wait on the host in
+            # steady state.  depth=0 = the unpipelined reference shape.
+            batches = prefetch_to_device(
+                self.train_loader, self.mesh, depth=self.prefetch_depth,
+                workers=self.prefetch_workers, stats=self.prefetch_stats)
+        for device_batch in batches:
             self.state, loss = self.train_step(
                 self.state, device_batch, self.rng)
             epoch_losses.append(loss)

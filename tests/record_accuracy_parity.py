@@ -59,6 +59,14 @@ def main() -> None:
                         "in a NON-saturated accuracy regime (ceiling = "
                         "1 - 0.9*p), where a framework difference could "
                         "not hide behind 100%%-vs-100%%.")
+    p.add_argument("--bf16", action="store_true",
+                   help="Record the ddp_tpu side in bfloat16 compute "
+                        "(BASELINE.json config #4) against the fp32 torch "
+                        "reference math: the per-step lockstep horizon is "
+                        "shorter (bf16 rounding replaces fusion-order ULP "
+                        "noise as the drift seed), but the acceptance "
+                        "shape — both sides converging to the label-noise "
+                        "Bayes ceiling — must survive the precision")
     p.add_argument("--out", default=None,
                    help="Output path; derived from the seed triple when "
                         "omitted, so a non-default-seed recording can "
@@ -73,6 +81,8 @@ def main() -> None:
                 f"{SHUFFLE_SEED}")
         if args.label_noise > 0.0:
             stem += f"_noise{args.label_noise:g}"
+        if args.bf16:
+            stem += "_bf16"
         args.out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "golden", f"{stem}.json")
 
@@ -110,14 +120,20 @@ def main() -> None:
     mesh = make_mesh(1)
     sched = functools.partial(triangular_lr, base_lr=BASE_LR,
                               num_epochs=args.epochs, steps_per_epoch=SPE)
-    step_fn = make_train_step(model, SGDConfig(lr=BASE_LR), sched, mesh)
+    import jax.numpy as jnp
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    step_fn = make_train_step(model, SGDConfig(lr=BASE_LR), sched, mesh,
+                              compute_dtype=compute_dtype)
     state = init_train_state(params, stats)
     opt, lr_sched = make_reference_optimizer(
         tmodel, lr=BASE_LR, num_epochs=args.epochs, steps_per_epoch=SPE)
 
     @jax.jit
     def jax_eval_logits(params, stats):
-        logits, _ = model.apply(params, stats, x_test, train=False)
+        # Same precision as training (cli._eval evaluates the very model it
+        # trained, in its compute dtype).
+        logits, _ = model.apply(params, stats, x_test, train=False,
+                                compute_dtype=compute_dtype)
         return logits
 
     def jax_acc() -> float:
@@ -165,6 +181,7 @@ def main() -> None:
                         "machine": platform.machine()},
         "config": {
             "model": "vgg", "batch": BATCH, "base_lr": BASE_LR,
+            "compute_dtype": "bfloat16" if args.bf16 else "float32",
             "steps_per_epoch": SPE, "epochs": args.epochs,
             "n_train": SPE * BATCH, "n_test": N_TEST,
             "init": f"torch.manual_seed({INIT_SEED}) TorchVGG state_dict",

@@ -63,3 +63,54 @@ def test_deterministic_given_key():
     a = random_crop_flip(jax.random.key(7), imgs)
     b = random_crop_flip(jax.random.key(7), imgs)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_distribution_parity_vs_host_implementation():
+    """Distribution-equality against the host/native augmentation (the
+    ISSUE-2 device-augment acceptance test): both implementations draw
+    offsets uniform over [0, 8]^2 and flips Bernoulli(0.5) — decode every
+    draw from marker images and compare the empirical marginals between
+    the two implementations (and against the analytic distribution).
+
+    n = 4096: a per-bin frequency has sd ~ 0.005, so the 0.025 tolerance
+    is ~5 sigma — a wrong padding convention, an off-by-one offset range,
+    or a biased flip shows up as a >= 0.11 bin shift, far outside it."""
+    from ddp_tpu.data.augment import random_crop_flip as host_crop_flip
+
+    n = 4096
+    imgs = np.zeros((n, 32, 32, 3), np.uint8)
+    imgs[:, 16, 20, :] = 255
+    imgs[:, 16, 12, :] = 128
+
+    host_out = host_crop_flip(imgs, np.random.default_rng(11))
+    dev_out = np.asarray(random_crop_flip(jax.random.key(11),
+                                          jnp.asarray(imgs)))
+
+    def decode(out):
+        ys, xs, flips = [], [], []
+        for img in out:
+            pos255 = np.argwhere(img[:, :, 0] == 255)
+            assert len(pos255) == 1  # marker preserved exactly
+            y, x = map(int, pos255[0])
+            pos128 = np.argwhere(img[:, :, 0] == 128)
+            assert len(pos128) == 1
+            flip = int(pos128[0][1]) > x
+            ys.append(16 + 4 - y)
+            xs.append(x - 7 if flip else 24 - x)
+            flips.append(flip)
+        return np.asarray(ys), np.asarray(xs), np.asarray(flips)
+
+    for (ys, xs, flips) in (decode(host_out), decode(dev_out)):
+        assert ys.min() >= 0 and ys.max() <= 8
+        assert xs.min() >= 0 and xs.max() <= 8
+    h_ys, h_xs, h_fl = decode(host_out)
+    d_ys, d_xs, d_fl = decode(dev_out)
+    for h, d in ((h_ys, d_ys), (h_xs, d_xs)):
+        h_freq = np.bincount(h, minlength=9) / n
+        d_freq = np.bincount(d, minlength=9) / n
+        np.testing.assert_allclose(h_freq, 1 / 9, atol=0.025)
+        np.testing.assert_allclose(d_freq, 1 / 9, atol=0.025)
+        np.testing.assert_allclose(h_freq, d_freq, atol=0.03)
+    assert abs(h_fl.mean() - 0.5) < 0.03
+    assert abs(d_fl.mean() - 0.5) < 0.03
+    assert abs(h_fl.mean() - d_fl.mean()) < 0.04

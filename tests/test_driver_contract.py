@@ -42,11 +42,16 @@ def test_bench_prints_one_json_line():
     # device kinds with a measured MXU peak — not this CPU-mesh child.
     assert set(rec) == {"metric", "value", "unit", "vs_baseline",
                         "wall_ms_per_step", "window_ms_per_step",
-                        "median_ms_per_step", "window_spread_pct"}
+                        "median_ms_per_step", "best_window_ms_per_step",
+                        "window_spread_pct"}
     assert rec["value"] > 0 and rec["unit"] == "samples/sec/chip"
     assert rec["wall_ms_per_step"] > 0
     assert len(rec["window_ms_per_step"]) == 1  # --repeats 1
-    assert rec["median_ms_per_step"] >= rec["wall_ms_per_step"]
+    # Median-based headline (VERDICT r5 weak #1): the headline wall time
+    # IS the median window; the best window is recorded separately as the
+    # capability bound and can only be <= it.
+    assert rec["median_ms_per_step"] == rec["wall_ms_per_step"]
+    assert rec["best_window_ms_per_step"] <= rec["median_ms_per_step"]
     assert rec["window_spread_pct"] >= 0
 
 
@@ -78,6 +83,61 @@ def test_bench_sweep_contract():
                         "samples_per_sec_per_chip"}
     assert set(rec["samples_per_sec_per_chip"]) == {"1", "2"}
     assert all(v > 0 for v in rec["samples_per_sec_per_chip"].values())
+
+
+@pytest.mark.slow
+def test_bench_batch_sweep_contract():
+    """--batch_sweep: one child per (batch, flavor) cell, one summary JSON
+    line whose batch_sweep table carries median-based rates per cell (the
+    MFU-vs-batch harness of ISSUE 2; the chip recording is
+    `--batch_sweep 256,512,1024,2048` with all four flavors)."""
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--batch_sweep", "8,16",
+         "--batch_sweep_flavors", "fp32_step", "--model", "deepnn",
+         "--steps", "2", "--warmup", "1", "--repeats", "1"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                        "batch_sweep"}
+    assert set(rec["batch_sweep"]) == {"8", "16"}
+    for cells in rec["batch_sweep"].values():
+        assert set(cells) == {"fp32_step"}
+        cell = cells["fp32_step"]
+        assert cell["samples_per_sec_per_chip"] > 0
+        assert cell["median_ms_per_step"] > 0
+        assert cell["best_window_ms_per_step"] <= cell["median_ms_per_step"]
+    assert rec["value"] > 0
+
+
+@pytest.mark.slow
+def test_bench_stream_attr_contract():
+    """--stream_attr: the streaming-gap attribution record — stage costs,
+    pipeline floor, dispatch gap, and the prefetch engine's occupancy
+    counters, in one JSON line (the harness behind BASELINE.md's round-6
+    streaming table)."""
+    env = dict(os.environ, PYTHONPATH=_REPO)
+    out = subprocess.run(
+        [sys.executable, "bench.py", "--stream_attr", "--model", "deepnn",
+         "--batch_size", "8", "--steps", "2", "--warmup", "1",
+         "--repeats", "2", "--e2e_steps", "4"],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, out.stdout
+    rec = json.loads(lines[0])
+    attr = rec["attribution_ms_per_step"]
+    assert {"host_augment_ms", "h2d_ms", "device_step_ms",
+            "streaming_wall_ms", "bottleneck", "pipeline_floor_ms",
+            "dispatch_gap_ms", "overlap_efficiency"} <= set(attr)
+    assert attr["pipeline_floor_ms"] == max(
+        attr["host_augment_ms"], attr["h2d_ms"], attr["device_step_ms"])
+    pf = rec["prefetch"]
+    assert pf["depth"] == 2 and pf["workers"] == 4
+    assert pf["batches"] == 4 * 2  # e2e_steps x timed repeats
 
 
 @pytest.mark.slow

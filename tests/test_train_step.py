@@ -293,6 +293,7 @@ def test_accuracy_parity_artifact():
         assert cfg["epochs"] == 20 and cfg["model"] == "vgg", path
         assert cfg["batch"] == 64 and cfg["base_lr"] == 0.05, path
         noise = cfg.get("label_noise", 0.0)
+        dtype = cfg.get("compute_dtype", "float32")
         # The artifacts must be genuinely distinct recordings: extract
         # the (data, init, shuffle) triple from the provenance strings
         # and require uniqueness (catches a non-default-seed run that
@@ -300,7 +301,7 @@ def test_accuracy_parity_artifact():
         triple = (re.search(r"seed=(\d+)", cfg["data"]).group(1),
                   re.search(r"manual_seed\((\d+)\)", cfg["init"]).group(1),
                   re.search(r"rng\((\d+)", cfg["shuffle"]).group(1),
-                  noise)
+                  noise, dtype)
         assert triple not in seed_triples, (path, triple)
         seed_triples.append(triple)
         pe = art["per_epoch"]
@@ -308,10 +309,18 @@ def test_accuracy_parity_artifact():
         # Lockstep horizon: the first TWO epochs' mean losses <1.5% apart
         # (seed-dependent — the primary seed holds <1% through epoch 3,
         # seed 2 starts drifting at epoch 2; two epochs = 24 optimizer
-        # steps is the horizon every recorded seed sustains).
+        # steps is the horizon every recorded seed sustains).  The bf16
+        # recording (config #4, VERDICT r5 weak #6) compares bf16 compute
+        # against the SAME fp32 torch reference math: bf16 rounding
+        # replaces fusion-order ULP noise as the drift seed, so the
+        # bound is widened to 3% (the recorded artifact tracks to 0.3% /
+        # 1.0% over epochs 0-1; the slack covers re-recordings — drift
+        # onset is seed-dependent, and the load-bearing bf16 claim is the
+        # ENDPOINT ceiling below, not lockstep).
+        lockstep = 0.015 if dtype == "float32" else 0.03
         for r in pe[:2]:
             assert (abs(r["jax_mean_loss"] - r["torch_mean_loss"])
-                    / abs(r["torch_mean_loss"]) < 0.015), (path, r)
+                    / abs(r["torch_mean_loss"]) < lockstep), (path, r)
         if noise == 0.0:
             # Endpoint: both sides fully learn the held-out split (chance
             # = 10%) — at every seed.
