@@ -76,17 +76,12 @@ from ddp_tpu.train.step import init_train_state
 BASELINE_BENCH = 22897.0
 BASELINE_BENCH_BF16 = 30372.0
 
-# FLOP model for absolute-efficiency reporting (VERDICT r3 weak #5): VGG
-# trains at ~3.6 GFLOP/sample (fwd + dgrad + wgrad conv FLOPs; BASELINE.md
-# roofline, "1.84 TFLOP/step at batch 512").  MFU is reported against the
-# bf16-pass MXU peak MEASURED on the chip family actually running the
-# bench, keyed by device_kind — the right denominator for BOTH precisions
-# here, because the fp32 path's convs also run as single-pass
-# bf16-input/fp32-accum MXU passes (BASELINE.md).  On a device kind with
-# no measured peak the "mfu" field is omitted rather than silently
-# computed against the wrong denominator (ADVICE r4).
-TRAIN_GFLOP_PER_SAMPLE = {"vgg": 3.6}
-PEAK_TFLOPS_BF16_PASS = {"TPU v5 lite": 197.0}  # measured, BASELINE.md
+# FLOP model + measured MXU peaks: single home in ddp_tpu/obs/live.py
+# (round 7) so the LIVE MFU the trainer emits every --log_every steps and
+# the offline bench MFU can never disagree on the denominator.  Re-bound
+# here so existing consumers of bench.TRAIN_GFLOP_PER_SAMPLE keep working.
+from ddp_tpu.obs.live import (PEAK_TFLOPS_BF16_PASS,  # noqa: F401
+                              TRAIN_GFLOP_PER_SAMPLE, model_mfu)
 
 
 def _parse_args():
@@ -335,10 +330,10 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
             "window_spread_pct": round(
                 (max(dts) - min(dts)) / min(dts) * 100.0, 1),
         }
-        gflop = TRAIN_GFLOP_PER_SAMPLE.get(args.model)
-        peak = PEAK_TFLOPS_BF16_PASS.get(jax.devices()[0].device_kind)
-        if gflop is not None and peak is not None:
-            rec["mfu"] = round(sps_chip * gflop * 1e9 / (peak * 1e12), 4)
+        mfu = model_mfu(sps_chip, args.model,
+                        jax.devices()[0].device_kind)
+        if mfu is not None:
+            rec["mfu"] = round(mfu, 4)
         if extra:
             rec.update(extra)
         return rec
@@ -498,10 +493,23 @@ def _bench_batch_sweep(args) -> None:
 def _bench_stream_attr(args) -> None:
     """Streaming-gap attribution (VERDICT r5 weak #5 / next #4): the
     BASELINE.md table decomposing the host-fed streaming path's wall time
-    into host-augment / H2D / device-step / dispatch-gap, each measured in
-    isolation at the training shape, plus the end-to-end streaming epoch
-    through the real Trainer with the prefetch engine's own occupancy
-    counters (consumer wait ~ 0 == the input pipeline is hidden).
+    into host-augment / H2D / device-step / dispatch-gap, plus the
+    end-to-end streaming epoch through the real Trainer with the prefetch
+    engine's own occupancy counters (consumer wait ~ 0 == the input
+    pipeline is hidden).
+
+    Since round 7 the record also carries the span TRACER'S account of
+    the timed streaming epochs themselves (obs/tracer.py — the same
+    instrumentation a production run spills): a ``phase_ms`` median
+    block per phase, so BENCH_r0N.json trajectories stay attributable
+    across rounds.  The three ``attribute_streaming`` STAGE inputs stay
+    isolated measurements ON PURPOSE: the pipeline-floor model needs
+    each stage's uncontended sequential cost, and the in-run spans
+    measure something else — h2d/dispatch spans are async-dispatch
+    *enqueue* times (~0 exactly when the link is the wall), and
+    host_augment span walls inflate under worker contention (4 workers
+    sharing cores time ~4x the sequential cost).  Spans explain the run
+    you ran; the isolated stages bound the run you could have.
 
     Pipeline model: perfectly overlapped, wall/step == max(stage); the
     excess is serialization the engine failed to hide.  On a real TPU the
@@ -511,6 +519,8 @@ def _bench_stream_attr(args) -> None:
     import io
 
     from ddp_tpu.data import PrefetchStats, TrainLoader
+    from ddp_tpu.obs.aggregate import phase_medians
+    from ddp_tpu.obs.tracer import SpanTracer
     from ddp_tpu.train import Trainer
     from ddp_tpu.utils.profiling import attribute_streaming
 
@@ -533,7 +543,9 @@ def _bench_stream_attr(args) -> None:
     def median_epoch_s(run_epoch) -> float:
         return statistics.median([_t(run_epoch) for _ in range(repeats)])
 
-    # Stage 1 — host augment+materialise, no device (the --pipeline rate).
+    # Isolated stage — host augment+materialise, SEQUENTIAL (the
+    # pipeline-floor model needs the stage's uncontended per-step cost;
+    # the real run's host_augment spans land in phase_ms instead).
     loader.set_epoch(0)
     for _ in loader:  # warm allocator/rng pools
         pass
@@ -544,7 +556,9 @@ def _bench_stream_attr(args) -> None:
 
     host_ms = median_epoch_s(host_epoch) / steps * 1e3
 
-    # Stage 2 — H2D upload alone: pre-materialised batches, blocking put.
+    # Isolated stage — H2D upload alone: pre-materialised batches,
+    # BLOCKING put (block_until_ready is what captures the actual
+    # transfer; the tracer's h2d span is only the enqueue).
     host_batches = [loader.materialize(k) for k in range(len(loader))]
 
     def h2d_epoch():
@@ -553,17 +567,21 @@ def _bench_stream_attr(args) -> None:
 
     jax.block_until_ready(shard_batch(host_batches[0], mesh))  # warm path
     h2d_ms = median_epoch_s(h2d_epoch) / steps * 1e3
+    del host_batches
 
-    # Stage 3 — device step alone (resident batch, steady state).
+    # Isolated stage — device step alone (resident batch, steady state):
+    # the other number the tracer cannot give (its dispatch span is
+    # enqueue time under async dispatch, an upper bound only through
+    # blocking backends/tunnels).
     schedule = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
                                  steps_per_epoch=98)
     step_fn = make_train_step(model, SGDConfig(), schedule, mesh,
                               compute_dtype=compute_dtype)
     # Fresh buffers: the jitted step DONATES its state, and params/stats
-    # must survive for the stage-4 Trainer below.
+    # must survive for the streaming Trainer below.
     state = init_train_state(jax.tree_util.tree_map(jnp.copy, params),
                              jax.tree_util.tree_map(jnp.copy, stats))
-    dev_batch = shard_batch(host_batches[0], mesh)
+    dev_batch = shard_batch(loader.materialize(0), mesh)
     rng = jax.random.key(0)
     for _ in range(max(args.warmup, 1)):
         state, loss = step_fn(state, dev_batch, rng)
@@ -578,30 +596,38 @@ def _bench_stream_attr(args) -> None:
     step_ms = median_epoch_s(step_epoch) / steps * 1e3
     del state, dev_batch
 
-    # Stage 4 — the real streaming path end to end (Trainer + prefetch).
+    # The real streaming path end to end (Trainer + prefetch), traced:
+    # host/h2d stage costs and the phase_ms block come from these spans.
     pstats = PrefetchStats()
+    # Ring sized to the whole run (warmup + timed + profile epochs, ~6
+    # spans/step) so phase_ms medians cover the FULL timed window — a
+    # default-sized ring would silently keep only the tail (the no-
+    # silent-caps rule the bench record follows).
+    tracer = SpanTracer(ring=max(4096, steps * (repeats + 4) * 8))
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=schedule, sgd_config=SGDConfig(),
                       save_every=10**9, snapshot_path=None,
                       compute_dtype=compute_dtype,
                       prefetch_depth=args.prefetch_depth,
                       prefetch_workers=args.prefetch_workers,
-                      prefetch_stats=pstats)
+                      prefetch_stats=pstats, tracer=tracer)
     with contextlib.redirect_stdout(io.StringIO()):
         trainer.train(2)  # compile + absorb second-dispatch staging cost
         trainer.prefetch_stats = pstats = PrefetchStats()  # timed window
+        t_window = tracer.now()  # spans before this are warmup
         dts = []
         for _ in range(repeats):
             t0 = time.perf_counter()
             trainer.train(1)  # train() restarts at epoch 0: 1 timed epoch
             trainer.flush_losses()
             dts.append(time.perf_counter() - t0)
+        phase_ms = phase_medians(tracer.spans_since(t_window))
         if args.profile_dir:
             # One traced (untimed) streaming epoch — the device-idle
             # cross-check RUNBOOK §6 describes (wall - busy from
             # utils/profiling.py:device_busy_ms_per_step == the idle this
             # mode attributes).  Tracing skews wall clock, so it never
-            # contributes to dts.
+            # contributes to dts (or to phase_ms, read before it).
             jax.profiler.start_trace(args.profile_dir)
             trainer.train(1)
             trainer.flush_losses()
@@ -616,9 +642,11 @@ def _bench_stream_attr(args) -> None:
                   f"{args.prefetch_workers}, {steps}-step epochs)",
         "value": attr["overlap_efficiency"],
         "unit": "pipeline overlap efficiency (slowest isolated stage / "
-                "streaming wall, per step)",
+                "streaming wall, per step; phase_ms = tracer spans of "
+                "the timed run)",
         "vs_baseline": 1.0,
         "attribution_ms_per_step": attr,
+        "phase_ms": {k: round(v, 3) for k, v in sorted(phase_ms.items())},
         "prefetch": {"depth": args.prefetch_depth,
                      "workers": args.prefetch_workers,
                      **pstats.per_step_ms()},
@@ -707,6 +735,8 @@ def _bench_e2e(args) -> None:
     import contextlib
     import io
 
+    from ddp_tpu.obs.aggregate import phase_medians
+    from ddp_tpu.obs.tracer import SpanTracer
     from ddp_tpu.train import Trainer
 
     mesh = make_mesh(args.num_devices)
@@ -720,6 +750,9 @@ def _bench_e2e(args) -> None:
                          augment=not args.resident)
     schedule = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
                                  steps_per_epoch=98)
+    # Ring sized to the whole run so phase_ms medians cover the full
+    # timed window (see _bench_stream_attr's sizing note).
+    tracer = SpanTracer(ring=max(4096, args.e2e_steps * 5 * 8))
     trainer = Trainer(model, loader, params, stats, mesh=mesh,
                       lr_schedule=schedule, sgd_config=SGDConfig(),
                       save_every=10**9, snapshot_path=None,
@@ -727,15 +760,22 @@ def _bench_e2e(args) -> None:
                       shard_update=args.shard_update,
                       compute_dtype=jnp.bfloat16 if args.bf16 else None,
                       prefetch_depth=args.prefetch_depth,
-                      prefetch_workers=args.prefetch_workers)
+                      prefetch_workers=args.prefetch_workers,
+                      tracer=tracer)
     with contextlib.redirect_stdout(io.StringIO()):
         # Two warmup epochs: the first compiles; the second absorbs the
         # one-time second-dispatch staging cost observed through remote
         # device tunnels (~12s on axon; zero on a local chip).
         trainer.train(2)
+        t_window = tracer.now()
         t0 = time.perf_counter()
         trainer.train(3)  # train() restarts at epoch 0: 3 timed epochs
         dt = time.perf_counter() - t0
+    # Tracer-derived per-phase medians over the timed window — the block
+    # that makes BENCH_r0N.json e2e trajectories attributable across
+    # rounds (which stage moved, not just the headline).
+    phase_ms = {k: round(v, 3) for k, v in sorted(
+        phase_medians(tracer.spans_since(t_window)).items())}
     samples = n_train * 3
     sps_chip = samples / dt / n_chips
     feed_mode = ("HBM-resident data" if args.resident
@@ -750,6 +790,7 @@ def _bench_e2e(args) -> None:
         "value": round(sps_chip, 2),
         "unit": "samples/sec/chip",
         "vs_baseline": 1.0,
+        "phase_ms": phase_ms,
     }))
 
 

@@ -101,6 +101,33 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Also mirror the per-step loss/LR (and periodic "
                         "eval accuracy) as TensorBoard scalars into this "
                         "directory (rank 0; needs tensorflow)")
+    # Observability surface (ddp_tpu/obs/): always-on span tracing with a
+    # kill-switch, plus the rolling live-stats cadence.
+    p.add_argument("--trace_spill", default="trace_spill.jsonl",
+                   metavar="PATH",
+                   help="Span-tracer spill file (obs/tracer.py): one JSON "
+                        "line per completed phase span (data_wait/"
+                        "host_augment/h2d/dispatch/loss_flush/ckpt_write/"
+                        "eval); analyze or export to Perfetto with "
+                        "python -m ddp_tpu.obs.  Multi-host ranks >0 "
+                        "append a .hostN suffix.  Default "
+                        "trace_spill.jsonl (same always-on overwrite "
+                        "discipline as checkpoint.pt); '' keeps the "
+                        "in-memory tracer (watchdog/straggler telemetry) "
+                        "without a spill file")
+    p.add_argument("--obs_off", action="store_true",
+                   help="Telemetry kill-switch: no span tracer, no spill "
+                        "file, no live stats, no per-epoch straggler "
+                        "record — hot paths see the shared no-op tracer "
+                        "(zero measurable step-time overhead, the "
+                        "contract CI checks)")
+    p.add_argument("--log_every", default=50, type=int, metavar="N",
+                   help="Emit a live telemetry record (obs/live.py: "
+                        "rolling median/p90 step time, samples/sec, MFU "
+                        "when the model+device have a FLOP model, "
+                        "prefetch occupancy) into the metrics stream "
+                        "every N steps (rank 0; needs --metrics_path or "
+                        "--tensorboard_dir to have a sink; 0 = off)")
     p.add_argument("--device_augment", "--augment_device",
                    action="store_true",
                    help="Run RandomCrop+HFlip on the TPU inside the train "
@@ -464,6 +491,51 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         args.metrics_path,
         tensorboard_dir=(args.tensorboard_dir
                          if jax.process_index() == 0 else None))
+    # Observability surface (ddp_tpu/obs/): the span tracer is installed
+    # process-wide for the run's duration (evaluate()/save_checkpoint()
+    # read the process tracer) and restored to the no-op tracer after —
+    # embedding callers and back-to-back in-process runs must not inherit
+    # a closed spill handle.  --obs_off keeps the NullTracer: hot paths
+    # then cost two trivial method calls per span (the zero-overhead
+    # kill-switch contract).
+    from .obs.tracer import NullTracer, SpanTracer, set_tracer
+    if args.obs_off:
+        tracer = NullTracer()
+        # Remove a previous traced run's spill at this path: leaving it
+        # would hand `python -m ddp_tpu.obs` a stale run's timeline with
+        # nothing marking it as such (same overwrite-in-place discipline
+        # as the traced branch, which truncates).
+        stale = args.trace_spill or None
+        if stale and jax.process_index() > 0:
+            stale = f"{stale}.host{jax.process_index()}"
+        if stale:
+            import contextlib
+            with contextlib.suppress(OSError):
+                os.unlink(stale)
+    else:
+        spill = args.trace_spill or None
+        if spill and jax.process_index() > 0:
+            spill = f"{spill}.host{jax.process_index()}"
+        # Ring sized to one epoch (~5 serial+overlap spans per step plus
+        # boundary phases): the per-epoch straggler medians read
+        # spans_since(epoch start), and a default-sized ring would
+        # silently cover only a large epoch's tail (the no-silent-caps
+        # rule bench.py documents).  The spill file is never truncated
+        # by the ring — offline reports see every span regardless.
+        ring = max(4096, len(train_loader) * 8)
+        try:
+            tracer = SpanTracer(spill_path=spill, ring=ring,
+                                host=jax.process_index())
+        except OSError as e:
+            # An unwritable spill location must not kill a training run
+            # the way it would not have before telemetry existed —
+            # degrade to ring-only (watchdog/straggler telemetry keeps
+            # working; only the offline spill is lost), loudly.
+            print(f"WARNING: cannot open --trace_spill {spill!r} ({e}); "
+                  "tracing continues in-memory only (no spill file)",
+                  file=sys.stderr)
+            tracer = SpanTracer(spill_path=None, ring=ring,
+                                host=jax.process_index())
     # Resilience surface (ddp_tpu/resilience/): graceful SIGTERM/SIGINT
     # handling is on whenever we own the main thread (signal.signal is
     # main-thread-only; embedded callers keep their own handlers), the
@@ -471,30 +543,98 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     import threading
 
     from .resilience.preemption import PreemptionGuard
-    preemption = (PreemptionGuard().install()
-                  if threading.current_thread() is threading.main_thread()
-                  else None)
+    preemption = None
     try:
+        # Install-and-restore both process-wide effects (tracer, signal
+        # handlers) INSIDE one bracket: an exception anywhere between —
+        # the guard install included — must not leak either into an
+        # embedding process.
+        set_tracer(tracer)
+        preemption = (PreemptionGuard().install()
+                      if threading.current_thread()
+                      is threading.main_thread() else None)
         return _run_guarded(args, preemption, metrics, model, train_loader,
                             params, batch_stats, mesh, lr_schedule,
                             compute_dtype, device_augment, test_ds,
-                            n_replicas, local_replicas)
+                            n_replicas, local_replicas, tracer)
     finally:
         # Handlers must not outlive the run even when construction (e.g. a
         # resume with every checkpoint torn) raises before training starts
         # — an embedding process keeps its own signal behavior.
         if preemption is not None:
             preemption.uninstall()
+        set_tracer(NullTracer())
+        tracer.close()
 
 
 def _run_guarded(args, preemption, metrics, model, train_loader, params,
                  batch_stats, mesh, lr_schedule, compute_dtype,
-                 device_augment, test_ds, n_replicas, local_replicas) -> float:
+                 device_augment, test_ds, n_replicas, local_replicas,
+                 tracer) -> float:
     """The trainer-lifetime tail of :func:`_run_body`, inside the
     preemption guard's install/uninstall bracket."""
     from .resilience.watchdog import Watchdog
-    watchdog = (Watchdog(args.watchdog_secs) if args.watchdog_secs > 0
-                else None)
+    # A stall report that names the last completed span per host turns
+    # "exit 124" into a diagnosis — wired only when the tracer is live.
+    # on_expire force-lands the spill tail: the watchdog dies via
+    # os._exit, which skips Python buffer flushing, and the spans leading
+    # into the stall are exactly the ones the spill exists to preserve.
+    # Every hook is BOUNDED — the tracer lock may be held by a thread
+    # wedged in a spill write to a hung mount, and fsync itself can hang
+    # on such a mount; the expire path must reach exit 124 regardless
+    # (its entire reason to exist), so the flush runs on a side thread
+    # with a join timeout and the span summary takes the lock with one.
+    def _flush_spill_bounded() -> None:
+        import threading as _threading
+        t = _threading.Thread(
+            target=lambda: tracer.flush(fsync=True, lock_timeout=2.0),
+            daemon=True, name="obs-spill-flush")
+        t.start()
+        t.join(timeout=3.0)
+
+    watchdog = (Watchdog(args.watchdog_secs,
+                         context=((lambda: tracer.describe_last(
+                             lock_timeout=2.0)) if tracer.enabled
+                             else None),
+                         on_expire=(_flush_spill_bounded if tracer.enabled
+                                    else None))
+                if args.watchdog_secs > 0 else None)
+    # Live telemetry (obs/live.py): the PrefetchStats occupancy counters
+    # feed the per-step metrics stream instead of dying with the engine
+    # object; rank 0 only, and only when a metrics sink exists.
+    from .data import PrefetchStats
+    from .obs.live import LiveStats
+    pstats = None
+    live = None
+    if (not args.obs_off and args.log_every > 0 and metrics.active
+            and jax.process_index() == 0 and args.resident):
+        # Resident mode has no per-step consumer loop to time: the whole
+        # epoch is ONE async dispatch, so loop intervals would measure
+        # enqueue time and report fantasy step rates.  Per-step resident
+        # attribution lives inside XLA (--profile_dir); say so instead
+        # of emitting wrong numbers.
+        print("note: live telemetry (--log_every) covers the streaming "
+              "path only; --resident epochs are one dispatch (use "
+              "--profile_dir for per-step attribution)", file=sys.stderr)
+    elif (not args.obs_off and args.log_every > 0 and metrics.active
+            and jax.process_index() == 0):
+        # The occupancy counters are only allocated when something will
+        # read them (the LiveStats emitter) — otherwise the prefetch hot
+        # path keeps its stats=None fast path (no perf_counter pairs).
+        pstats = PrefetchStats()
+        # One live 'step' is one optimizer step: under --grad_accum it
+        # consumes A micro-batches, so the samples/sec numerator scales.
+        live = LiveStats(metrics,
+                         global_batch=(args.batch_size * n_replicas
+                                       * max(args.grad_accum, 1)),
+                         n_chips=n_replicas, log_every=args.log_every,
+                         # Window >= cadence: a default 100-step window
+                         # under --log_every 500 would silently describe
+                         # only each interval's last 20% of steps.
+                         window=max(100, args.log_every),
+                         model=args.model,
+                         device_kind=jax.devices()[0].device_kind,
+                         prefetch_stats=pstats)
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
                       lr_schedule=lr_schedule,
                       sgd_config=SGDConfig(lr=args.lr,
@@ -511,7 +651,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       on_nan=args.on_nan,
                       watchdog=watchdog, preemption=preemption,
                       prefetch_depth=args.prefetch_depth,
-                      prefetch_workers=args.prefetch_workers)
+                      prefetch_workers=args.prefetch_workers,
+                      prefetch_stats=pstats, tracer=tracer, live=live)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real

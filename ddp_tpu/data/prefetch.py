@@ -27,8 +27,17 @@ Contracts the tests pin (tests/test_prefetch.py):
 host busy time (materialise + augment), H2D enqueue time, and consumer
 wait time (the dispatch gap: how long the device-feeding loop sat waiting
 for a batch that was not ready).  ``bench.py --stream_attr`` builds the
-BASELINE.md streaming-gap table from these plus isolated stage timings
+BASELINE.md streaming-gap table from these plus the tracer's span record
 (utils/profiling.py:attribute_streaming).
+
+Telemetry (round 7): every stage also reports into the run's span tracer
+(obs/tracer.py) — ``host_augment`` and ``h2d`` spans from wherever they
+actually run (marked ``overlap=True`` on producer threads, whose time
+hides behind the consumer loop), ``data_wait`` from the consumer's side
+of the queue.  ``step0`` anchors span step numbers at the trainer's
+global step so "where did step 4817 go" is answerable from the spill.
+With the default NullTracer the spans are shared no-op context managers
+— the ``--obs_off`` zero-overhead contract.
 """
 from __future__ import annotations
 
@@ -41,6 +50,7 @@ from typing import Dict, Iterable, Iterator, Optional
 
 import numpy as np
 
+from ..obs.tracer import get_tracer
 from ..train.step import shard_batch
 
 _DONE = object()
@@ -86,7 +96,8 @@ class PrefetchStats:
 def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,
                        depth: int = 2, workers: int = 4,
                        stats: Optional[PrefetchStats] = None,
-                       shard_fn=None) -> Iterator[dict]:
+                       shard_fn=None, tracer=None,
+                       step0: int = 0) -> Iterator[dict]:
     """Yield device-resident, data-sharded batches ahead of consumption.
 
     ``depth`` is how many batches may be in flight beyond the workers'
@@ -97,15 +108,20 @@ def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,
     mesh)`` overrides the host->device placement (default
     :func:`~ddp_tpu.train.step.shard_batch`; the accumulation path passes
     ``shard_batch_stacked`` for its ``[A, B, ...]`` group stacks).
+    ``tracer`` (default: the process tracer) receives host_augment/h2d/
+    data_wait spans, step-numbered from ``step0``.
     """
     shard = shard_batch if shard_fn is None else shard_fn
+    tracer = tracer if tracer is not None else get_tracer()
     if depth <= 0:
-        yield from _passthrough(iter(batches), mesh, stats, shard)
+        yield from _passthrough(iter(batches), mesh, stats, shard, tracer,
+                                step0)
     elif hasattr(batches, "materialize") and hasattr(batches, "__len__"):
         yield from _pooled(batches, mesh, depth, max(workers, 1), stats,
-                           shard)
+                           shard, tracer, step0)
     else:
-        yield from _threaded(iter(batches), mesh, depth, stats, shard)
+        yield from _threaded(iter(batches), mesh, depth, stats, shard,
+                             tracer, step0)
 
 
 def _timed(stats: Optional[PrefetchStats], field: str, fn, *args):
@@ -118,40 +134,62 @@ def _timed(stats: Optional[PrefetchStats], field: str, fn, *args):
 
 
 def _passthrough(batches: Iterator[Dict[str, np.ndarray]], mesh,
-                 stats: Optional[PrefetchStats], shard) -> Iterator[dict]:
+                 stats: Optional[PrefetchStats], shard, tracer,
+                 step0: int) -> Iterator[dict]:
     """The unpipelined reference shape: one batch materialised, shipped,
-    then consumed, strictly in sequence (singlegpu.py:104-107's loop)."""
+    then consumed, strictly in sequence (singlegpu.py:104-107's loop).
+    Everything runs on the consumer thread, so the spans are serial
+    (overlap=False) — exactly the attribution the depth-0 mode exists
+    to expose.  A span whose body raises StopIteration is not recorded
+    (tracer contract), so the exhaustion probe leaves no bogus span."""
+    k = step0
     while True:
         try:
-            batch = _timed(stats, "host_s", lambda: next(batches))
+            with tracer.span("host_augment", step=k):
+                batch = _timed(stats, "host_s", lambda: next(batches))
         except StopIteration:
             return
-        out = _timed(stats, "h2d_s", shard, batch, mesh)
+        with tracer.span("h2d", step=k):
+            out = _timed(stats, "h2d_s", shard, batch, mesh)
         if stats is not None:
             stats.count_batch()
+        k += 1
         yield out
 
 
+def _materialize_traced(tracer, stats, loader, k: int, step0: int):
+    """Worker-side materialise: host_augment span marked overlap=True —
+    pool workers run concurrently with the consumer loop, so their wall
+    time must not be summed against it."""
+    with tracer.span("host_augment", step=step0 + k, overlap=True):
+        return _timed(stats, "host_s", loader.materialize, k)
+
+
 def _pooled(loader, mesh, depth: int, workers: int,
-            stats: Optional[PrefetchStats], shard) -> Iterator[dict]:
+            stats: Optional[PrefetchStats], shard, tracer,
+            step0: int) -> Iterator[dict]:
     n = len(loader)
     pool = ThreadPoolExecutor(max_workers=workers,
                               thread_name_prefix="ddp_tpu_prefetch")
     futures: deque = deque()
     try:
-        futures.extend(pool.submit(_timed, stats, "host_s",
-                                   loader.materialize, k)
+        futures.extend(pool.submit(_materialize_traced, tracer, stats,
+                                   loader, k, step0)
                        for k in range(min(workers + depth, n)))
         next_k = len(futures)
+        i = 0
         while futures:
-            batch = _timed(stats, "wait_s", futures.popleft().result)
+            with tracer.span("data_wait", step=step0 + i):
+                batch = _timed(stats, "wait_s", futures.popleft().result)
             if next_k < n:
-                futures.append(pool.submit(_timed, stats, "host_s",
-                                           loader.materialize, next_k))
+                futures.append(pool.submit(_materialize_traced, tracer,
+                                           stats, loader, next_k, step0))
                 next_k += 1
-            out = _timed(stats, "h2d_s", shard, batch, mesh)
+            with tracer.span("h2d", step=step0 + i):
+                out = _timed(stats, "h2d_s", shard, batch, mesh)
             if stats is not None:
                 stats.count_batch()
+            i += 1
             yield out
     finally:
         # Abandoned mid-epoch (consumer exception/break/preemption): drop
@@ -161,7 +199,8 @@ def _pooled(loader, mesh, depth: int, workers: int,
 
 
 def _threaded(batches: Iterator[Dict[str, np.ndarray]], mesh, depth: int,
-              stats: Optional[PrefetchStats], shard) -> Iterator[dict]:
+              stats: Optional[PrefetchStats], shard, tracer,
+              step0: int) -> Iterator[dict]:
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
 
@@ -178,14 +217,22 @@ def _threaded(batches: Iterator[Dict[str, np.ndarray]], mesh, depth: int,
         return False
 
     def worker() -> None:
+        # Producer thread: host_augment + h2d both run here, hidden
+        # behind the consumer's dispatch — overlap=True spans.
+        k = step0
         try:
             while not stop.is_set():
                 try:
-                    batch = _timed(stats, "host_s", lambda: next(batches))
+                    with tracer.span("host_augment", step=k, overlap=True):
+                        batch = _timed(stats, "host_s",
+                                       lambda: next(batches))
                 except StopIteration:
                     break
-                if not _put(_timed(stats, "h2d_s", shard, batch, mesh)):
+                with tracer.span("h2d", step=k, overlap=True):
+                    item = _timed(stats, "h2d_s", shard, batch, mesh)
+                if not _put(item):
                     return
+                k += 1
         except BaseException as e:  # surfaced in the consumer thread
             _put((_ERROR, e))
             return
@@ -194,14 +241,24 @@ def _threaded(batches: Iterator[Dict[str, np.ndarray]], mesh, depth: int,
     t = threading.Thread(target=worker, daemon=True,
                          name="ddp_tpu_prefetch")
     t.start()
+    i = 0
     try:
         while True:
+            # Timed by hand, recorded only for REAL batches: the get that
+            # returns the end-of-stream/error sentinel is not a step's
+            # data wait, and spanning it would invent a phantom step
+            # numbered as the next epoch's first (add_span's reason).
+            t0 = time.monotonic() if tracer.enabled else 0.0
             item = _timed(stats, "wait_s", q.get)
             if item is _DONE:
                 return
             if isinstance(item, tuple) and len(item) == 2 \
                     and item[0] == _ERROR:
                 raise item[1]
+            if tracer.enabled:
+                tracer.add_span("data_wait", t0, time.monotonic() - t0,
+                                step=step0 + i)
+            i += 1
             if stats is not None:
                 stats.count_batch()
             yield item

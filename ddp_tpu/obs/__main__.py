@@ -1,0 +1,59 @@
+"""``python -m ddp_tpu.obs`` — read a run's span spill and explain it.
+
+Prints the phase-breakdown table (serial vs overlap lanes, with the
+serial-phase sum as a fraction of wall — the within-10% acceptance
+identity), a step-time histogram, and the slowest-K steps with their
+per-phase decomposition; ``--perfetto OUT.json`` additionally exports a
+schema-validated Chrome/Perfetto ``trace_event`` file for
+``ui.perfetto.dev``.
+
+Multi-host runs spill one file per host (``--trace_spill`` path plus
+``.hostN`` suffixes); pass them all — the terminal report prints one
+section per host (hosts' clocks are independent and each host's serial
+lanes tile its own wall), and the Perfetto export lays the hosts side
+by side (one process per host).
+
+Usage:
+    python -m ddp_tpu.obs trace_spill.jsonl [more_spills...]
+        [--perfetto trace.json] [--top 10] [--bins 12]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from .export import format_report, read_spill
+
+
+def main(argv: Optional[list] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.obs",
+        description=__doc__.splitlines()[0])
+    p.add_argument("spill", nargs="+",
+                   help="Span spill file(s) from --trace_spill (one per "
+                        "host; pass all of a run's files to merge)")
+    p.add_argument("--perfetto", default=None, metavar="OUT.json",
+                   help="Also export a schema-validated Chrome/Perfetto "
+                        "trace_event JSON (open in ui.perfetto.dev)")
+    p.add_argument("--top", type=int, default=10,
+                   help="Slowest-K steps to list (default 10)")
+    p.add_argument("--bins", type=int, default=12,
+                   help="Step-time histogram bins (default 12)")
+    args = p.parse_args(argv)
+    spans = read_spill(args.spill)
+    if not spans:
+        print(f"no spans found in {args.spill} — was the run --obs_off, "
+              "or killed before the first flush?", file=sys.stderr)
+        return 1
+    try:
+        print(format_report(spans, top=args.top, bins=args.bins,
+                            perfetto_out=args.perfetto))
+    except BrokenPipeError:  # `... | head` closed the pipe: not an error
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,140 @@
+"""Cross-host straggler attribution: per-phase medians gathered over the
+mesh, the slowest host named per phase with its skew vs the median.
+
+Mesh-wide aggregation of per-device/per-host timings is what makes
+multi-chip behavior legible (Mesh-TensorFlow, arxiv 1811.02084); here
+each host summarises its own tracer ring into per-phase median
+durations, the vectors are gathered through the same device-collective
+pattern as ``mesh.process_min_mib`` (asymmetric-topology-safe, no
+``process_allgather`` reshape assumptions), and every host derives the
+identical per-epoch verdict: for each phase, which host is slowest and
+by how much.  Rank 0 logs the record (``phase_stragglers``) into the
+metrics stream once per epoch.
+
+Multi-host only runs the collective when ``mesh`` is given and there is
+more than one process; single-host (including the CPU test tier, whose
+backend must not enqueue extra programs behind an in-flight epoch —
+see trainer._save_checkpoint's hazard note) takes a pure-numpy path
+with the same record shape, so the record's consumers are exercised
+everywhere even though the interesting skews only exist on pods.
+
+The *stall*-time counterpart (when an epoch never completes and no
+record can be gathered) is the watchdog's per-host last-completed-span
+report (resilience/watchdog.py ``context`` hook): collectives are wedged
+by definition during a stall, so each host prints its own tail locally.
+"""
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .export import PHASE_ORDER
+
+# Phases excluded from the cross-host verdict because they are
+# structurally rank-ASYMMETRIC: only rank 0 pays the checkpoint write
+# (the join/snapshot serial span AND the writer thread — the rank-0 gate
+# is the reference's own design, multigpu.py:118), so host 0 would be
+# named its "straggler" every save epoch, burying real skew.  The phase
+# stays in per-host reports and bench phase_ms; it just cannot be
+# compared ACROSS hosts.
+STRAGGLER_EXCLUDED_PHASES = frozenset(("ckpt_write",))
+
+
+def phase_medians(spans: List[dict],
+                  include_overlap: bool = True) -> Dict[str, float]:
+    """Median duration (ms) per phase over a span window.
+
+    ``include_overlap=False`` restricts to serial (consumer-loop) spans —
+    what the cross-host straggler gather compares: overlap spans are
+    structurally rank-ASYMMETRIC (only rank 0 runs the checkpoint writer
+    thread), so pooling them would flag the writer rank as a ckpt_write
+    "straggler" every epoch.  A genuinely slow producer still surfaces
+    in the gather through its serial consequence, ``data_wait``.  Bench's
+    ``phase_ms`` block keeps the full (overlap-included) medians."""
+    durs: Dict[str, List[float]] = {}
+    for s in spans:
+        if not include_overlap and s.get("overlap"):
+            continue
+        durs.setdefault(s["phase"], []).append(float(s["dur_s"]))
+    return {p: statistics.median(d) * 1e3 for p, d in durs.items()}
+
+
+def _median_vector(medians: Dict[str, float]) -> np.ndarray:
+    """Fixed-order vector over the canonical phases (absent phase = 0) —
+    the gather needs every host to contribute the same-shaped row."""
+    return np.asarray([medians.get(p, 0.0) for p in PHASE_ORDER],
+                      np.float32)
+
+
+def _gather_host_rows(mesh, vec: np.ndarray) -> List[tuple]:
+    """All-gather one float32 row per host over the mesh's devices;
+    returns ``[(host_id, row), ...]`` — a device COLLECTIVE, so every
+    process must call it at the same point (the trainer calls it once
+    per epoch boundary, before the preemption collective)."""
+    import jax
+
+    from ..parallel.mesh import (assemble_from_local, batch_sharding,
+                                 local_replica_ids, replicated_sharding)
+    n_local = len(local_replica_ids(mesh))
+    local = np.tile(vec[None, :], (n_local, 1))
+    vals = assemble_from_local(batch_sharding(mesh), local, 0)
+    rep = np.asarray(jax.jit(
+        lambda x: x + 0.0,
+        out_shardings=replicated_sharding(mesh))(vals))
+    rows, seen = [], set()
+    for i, d in enumerate(mesh.devices.flat):
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            rows.append((int(d.process_index), rep[i]))
+    return rows
+
+
+def straggler_report(medians: Dict[str, float], mesh=None
+                     ) -> Dict[str, dict]:
+    """Per-phase straggler verdict: ``{phase: {slowest_host, slowest_ms,
+    median_ms, skew_pct}}``.
+
+    With ``mesh`` and >1 process this is a collective (every rank must
+    call it); otherwise it degrades to the single-host identity record.
+    Phases nobody timed this epoch are omitted.
+    """
+    import jax
+    if mesh is not None and jax.process_count() > 1:
+        rows = _gather_host_rows(mesh, _median_vector(medians))
+    else:
+        rows = [(0, _median_vector(medians))]
+    report: Dict[str, dict] = {}
+    for j, phase in enumerate(PHASE_ORDER):
+        if phase in STRAGGLER_EXCLUDED_PHASES:
+            continue  # rank-asymmetric by design: skew is structural
+        vals = [(h, float(row[j])) for h, row in rows]
+        if all(v == 0.0 for _, v in vals):
+            continue  # nobody recorded this phase this epoch
+        med = float(np.median([v for _, v in vals]))
+        slowest_host, slowest = max(vals, key=lambda hv: hv[1])
+        report[phase] = {
+            "slowest_host": slowest_host,
+            "slowest_ms": round(slowest, 3),
+            "median_ms": round(med, 3),
+            "skew_pct": round((slowest - med) / med * 100.0, 1)
+            if med > 0 else 0.0,
+        }
+    return report
+
+
+def epoch_straggler_record(tracer, mesh, since: float,
+                           metrics=None, epoch: Optional[int] = None
+                           ) -> Optional[Dict[str, dict]]:
+    """One epoch's cross-host attribution: summarise the tracer window,
+    gather, and (rank 0, when ``metrics`` is given) log the
+    ``phase_stragglers`` event.  Returns the report (all ranks)."""
+    if not getattr(tracer, "enabled", False):
+        return None
+    report = straggler_report(
+        phase_medians(tracer.spans_since(since), include_overlap=False),
+        mesh=mesh)
+    if metrics is not None and report:
+        metrics.log_event("phase_stragglers", epoch=epoch, phases=report)
+    return report

@@ -34,12 +34,20 @@ WATCHDOG_EXIT_STATUS = 124
 class Watchdog:
     def __init__(self, timeout_s: float, *, tag: str = "train",
                  on_expire: Optional[Callable[[], None]] = None,
+                 context: Optional[Callable[[], str]] = None,
                  exit_status: int = WATCHDOG_EXIT_STATUS):
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
         self.tag = tag
         self.on_expire = on_expire
+        # ``context()`` -> str is printed with the stall diagnostic —
+        # cli.py wires the span tracer's last-completed-span summary here
+        # (obs/tracer.py::describe_last), so a wedged run names WHAT each
+        # host finished last.  Per-host by design: collectives are down
+        # during the exact stalls this fires on, so no cross-host gather
+        # is possible — each host's stderr carries its own tail.
+        self.context = context
         self.exit_status = int(exit_status)
         self._last = time.monotonic()
         self._stop = threading.Event()
@@ -86,6 +94,14 @@ class Watchdog:
               f"service and hard-exiting {self.exit_status} so peers fail "
               "fast instead of riding the 300 s shutdown timeout",
               file=sys.stderr)
+        if self.context is not None:
+            try:
+                detail = self.context()
+            except Exception as e:
+                detail = f"<context hook failed: {e!r}>"
+            if detail:
+                print(f"WATCHDOG [{self.tag}]: last completed spans on "
+                      f"this host: {detail}", file=sys.stderr)
         sys.stderr.flush()
         try:
             if self.on_expire is not None:

@@ -91,12 +91,29 @@ def sha256_of_file(path: str, chunk: int = 1 << 20) -> str:
 
 
 def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
-                    step: int, epoch: int) -> str:
+                    step: int, epoch: int, tracer=None) -> str:
     """Atomic overwrite-in-place write (the reference overwrites too,
     multigpu.py:111 — atomically here so a preempted host never leaves a
     torn file for the other hosts to restore).  Returns the file's SHA-256
     hex digest — hashed from the tmp file BEFORE the rename, so the digest
-    provably describes the bytes that became ``path``."""
+    provably describes the bytes that became ``path``.
+
+    Telemetry: the write records a ``ckpt_write`` span (overlap=True —
+    the trainer calls this on its async writer thread, concurrent with
+    the next epoch's compute; the trainer's own serial span covers the
+    main-thread snapshot/join part).  ``tracer`` defaults to the process
+    tracer; the Trainer passes its own so an explicitly-traced run
+    (bench, embedders) keeps one coherent timeline."""
+    from ..obs.tracer import get_tracer
+    tracer = tracer if tracer is not None else get_tracer()
+    with tracer.span("ckpt_write", step=int(step), overlap=True):
+        return _save_checkpoint_body(path, params, batch_stats, opt_state,
+                                     step, epoch)
+
+
+def _save_checkpoint_body(path: str, params, batch_stats,
+                          opt_state: SGDState, step: int,
+                          epoch: int) -> str:
     flat: Dict[str, np.ndarray] = {}
     for section, tree in zip(_SECTIONS,
                              (params, batch_stats, opt_state.momentum_buf)):

@@ -11,6 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..obs.tracer import get_tracer
 from .step import make_eval_step, shard_batch
 
 try:
@@ -24,8 +25,20 @@ _step_cache: dict = {}
 
 
 def evaluate(model, params, batch_stats, loader, mesh, *,
-             compute_dtype=None, progress: bool = True) -> float:
-    """Accuracy in percent, as a Python float (reference singlegpu.py:205)."""
+             compute_dtype=None, progress: bool = True,
+             tracer=None) -> float:
+    """Accuracy in percent, as a Python float (reference singlegpu.py:205).
+    Records one ``eval`` span covering the full test-set pass (``tracer``
+    defaults to the process tracer cli.run installs)."""
+    tracer = tracer if tracer is not None else get_tracer()
+    with tracer.span("eval"):
+        return _evaluate_body(model, params, batch_stats, loader, mesh,
+                              compute_dtype=compute_dtype,
+                              progress=progress)
+
+
+def _evaluate_body(model, params, batch_stats, loader, mesh, *,
+                   compute_dtype=None, progress: bool = True) -> float:
     key = (model, mesh, compute_dtype)  # ModelDef is a hashable NamedTuple
     eval_step = _step_cache.get(key)
     if eval_step is None:
@@ -60,7 +73,7 @@ _epoch_cache: dict = {}
 
 
 def evaluate_resident(model, params, batch_stats, resident, loader, mesh, *,
-                      compute_dtype=None) -> float:
+                      compute_dtype=None, tracer=None) -> float:
     """Accuracy (%) over a device-resident test set, as ONE jitted scan.
 
     Same result as :func:`evaluate` (same masked ``psum`` counters —
@@ -75,9 +88,11 @@ def evaluate_resident(model, params, batch_stats, resident, loader, mesh, *,
     if eval_epoch is None:
         eval_epoch = _epoch_cache[key] = make_eval_epoch(
             model, mesh, compute_dtype=compute_dtype)
-    idx, mask = loader.epoch_index_matrix()
-    correct, total = eval_epoch(params, batch_stats, resident.images,
-                                resident.labels,
-                                put_index_matrix(idx, mesh),
-                                put_index_matrix(mask, mesh))
-    return float(correct) / max(float(total), 1.0) * 100.0
+    tracer = tracer if tracer is not None else get_tracer()
+    with tracer.span("eval"):
+        idx, mask = loader.epoch_index_matrix()
+        correct, total = eval_epoch(params, batch_stats, resident.images,
+                                    resident.labels,
+                                    put_index_matrix(idx, mesh),
+                                    put_index_matrix(mask, mesh))
+        return float(correct) / max(float(total), 1.0) * 100.0

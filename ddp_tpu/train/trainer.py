@@ -33,12 +33,14 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.tracer import get_tracer
 from ..optim.sgd import SGDConfig, SGDState
 from ..parallel import dist
 from ..utils.metrics import MetricsLogger
@@ -89,7 +91,9 @@ class Trainer:
                  preemption=None,
                  prefetch_depth: int = 2,
                  prefetch_workers: int = 4,
-                 prefetch_stats=None):
+                 prefetch_stats=None,
+                 tracer=None,
+                 live=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -162,6 +166,12 @@ class Trainer:
         self.prefetch_depth = prefetch_depth
         self.prefetch_workers = prefetch_workers
         self.prefetch_stats = prefetch_stats
+        # Telemetry (ddp_tpu/obs/): the span tracer every phase of the
+        # epoch loop reports into (default: the process tracer — a
+        # NullTracer unless cli.run installed a real one) and the
+        # rolling live-stats engine (rank 0, obs/live.py).
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._live = live if self.gpu_id == 0 else None
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
             # lives as one flat array sharded over ``data`` (1/R per chip).
@@ -226,7 +236,8 @@ class Trainer:
                 _stack_groups(self.train_loader, self.grad_accum),
                 self.mesh, depth=self.prefetch_depth,
                 workers=self.prefetch_workers, stats=self.prefetch_stats,
-                shard_fn=shard_batch_stacked)
+                shard_fn=shard_batch_stacked, tracer=self.tracer,
+                step0=self._host_step)
         else:
             # Worker pool augments + device_puts ahead of the loop (the
             # pin_memory/worker analogue, singlegpu.py:177); combined with
@@ -234,11 +245,27 @@ class Trainer:
             # steady state.  depth=0 = the unpipelined reference shape.
             batches = prefetch_to_device(
                 self.train_loader, self.mesh, depth=self.prefetch_depth,
-                workers=self.prefetch_workers, stats=self.prefetch_stats)
+                workers=self.prefetch_workers, stats=self.prefetch_stats,
+                tracer=self.tracer, step0=self._host_step)
+        step = self._host_step
+        t_prev = time.monotonic()
         for device_batch in batches:
-            self.state, loss = self.train_step(
-                self.state, device_batch, self.rng)
+            # The dispatch span covers the jitted call only — enqueue
+            # time plus whatever XLA makes it wait for (donated-buffer
+            # availability, compile on the first step); together with
+            # the prefetch engine's data_wait span this is the consumer
+            # loop's full wall, the "where did step N go" record.
+            with self.tracer.span("dispatch", step=step):
+                self.state, loss = self.train_step(
+                    self.state, device_batch, self.rng)
             epoch_losses.append(loss)
+            if self._live is not None:
+                # Same step id as this iteration's span and loss record —
+                # the three streams must join on one key.
+                now = time.monotonic()
+                self._live.step(now - t_prev, step=step)
+                t_prev = now
+            step += 1
             if self._watchdog is not None:
                 self._watchdog.beat()
         return jnp.stack(epoch_losses) if epoch_losses else None
@@ -263,24 +290,34 @@ class Trainer:
                 calls.append(full[n_groups * a:][None])
             if tail is not None:
                 calls.append(tail[None, None, :])
+            s = self._host_step
             for idx3 in calls:
                 idx = put_index_matrix(idx3, self.mesh)
-                self.state, losses = self.train_epoch(
-                    self.state, self.resident.images, self.resident.labels,
-                    idx, self.rng)
+                # One dispatch per scan call: the span's step is the call's
+                # FIRST optimizer step (the whole-epoch granularity is the
+                # resident mode's dispatch pattern — per-step attribution
+                # lives inside XLA, reachable via --profile_dir).
+                with self.tracer.span("dispatch", step=s):
+                    self.state, losses = self.train_epoch(
+                        self.state, self.resident.images,
+                        self.resident.labels, idx, self.rng)
+                s += idx3.shape[0]
                 parts.append(losses)
             return jnp.concatenate(parts) if parts else None
         if full.shape[0]:
             idx = put_index_matrix(full, self.mesh)
-            self.state, losses = self.train_epoch(
-                self.state, self.resident.images, self.resident.labels,
-                idx, self.rng)
+            with self.tracer.span("dispatch", step=self._host_step):
+                self.state, losses = self.train_epoch(
+                    self.state, self.resident.images, self.resident.labels,
+                    idx, self.rng)
             parts.append(losses)
         if tail is not None:
             idx = put_index_matrix(tail[None, :], self.mesh)
-            self.state, tail_loss = self.train_epoch(
-                self.state, self.resident.images, self.resident.labels,
-                idx, self.rng)
+            with self.tracer.span("dispatch",
+                                  step=self._host_step + full.shape[0]):
+                self.state, tail_loss = self.train_epoch(
+                    self.state, self.resident.images, self.resident.labels,
+                    idx, self.rng)
             parts.append(tail_loss)
         return jnp.concatenate(parts) if parts else None
 
@@ -307,6 +344,11 @@ class Trainer:
             self._flush_losses(*prev)
 
     def _flush_losses(self, epoch: int, start_step: int, stacked) -> None:
+        with self.tracer.span("loss_flush", step=start_step):
+            self._flush_losses_inner(epoch, start_step, stacked)
+
+    def _flush_losses_inner(self, epoch: int, start_step: int,
+                            stacked) -> None:
         # One stacked D2H transfer for the whole epoch's losses — per-scalar
         # reads pay a link round trip each on remote-device setups.
         arr = (np.asarray(jax.device_get(stacked))
@@ -369,6 +411,14 @@ class Trainer:
                 raise err
 
     def _save_checkpoint(self, epoch: int) -> None:
+        # The serial span covers the main-thread part only (device sync,
+        # snapshot copies, joining the previous writer); the file write
+        # itself runs on the writer thread and records its own
+        # overlap=True ckpt_write span from save_checkpoint.
+        with self.tracer.span("ckpt_write", step=self._host_step):
+            self._save_checkpoint_inner(epoch)
+
+    def _save_checkpoint_inner(self, epoch: int) -> None:
         # XLA:CPU hazard gate — BEFORE anything (the ZeRO conversion
         # below included) enqueues work behind the in-flight epoch: the
         # CPU backend executes per-device programs on a shared thread
@@ -431,7 +481,7 @@ class Trainer:
                     self.lineage.preserve_head()
                 sha = save_checkpoint(self.snapshot_path, snap_params,
                                       snap_stats, SGDState(snap_opt), step,
-                                      epoch)
+                                      epoch, tracer=self.tracer)
                 if self.lineage is not None:
                     self.lineage.commit(epoch=epoch, step=step, sha256=sha)
                 # Reference print, singlegpu.py:122.
@@ -498,6 +548,7 @@ class Trainer:
     def _train_one(self, epoch: int, epoch_callback) -> None:
         if self._watchdog is not None:
             self._watchdog.beat()
+        t_epoch = self.tracer.now()  # straggler-window marker
         self._run_epoch(epoch)
         # NB: like the reference, epoch 0 satisfies the modulo gate
         # — snapshot_path=None disables checkpointing entirely.
@@ -518,6 +569,7 @@ class Trainer:
             # an unconditional flush would re-serialize every
             # epoch boundary for monitored runs).
             epoch_callback(epoch)
+        self._log_stragglers(epoch, t_epoch)
         if self._preemption is not None:
             # COLLECTIVE on multi-host (resilience/preemption.py): every
             # rank calls it at every epoch boundary so the stop decision —
@@ -525,6 +577,33 @@ class Trainer:
             # in lockstep.
             if self._preemption.should_stop(epoch, self.mesh):
                 self._emergency_checkpoint(epoch)
+
+    def _log_stragglers(self, epoch: int, since: float) -> None:
+        """Per-epoch cross-host phase attribution (obs/aggregate.py).
+
+        Multi-host this is a COLLECTIVE (the per-host median gather), so
+        the gate must evaluate identically on every rank: tracer.enabled
+        comes from the shared CLI flags, never from rank-local state —
+        and it sits before the preemption collective, keeping the epoch
+        boundary's collective order fixed.  Single-host skips the device
+        round entirely (numpy path — the XLA:CPU backend must not see
+        extra programs behind an in-flight epoch, see
+        _save_checkpoint_inner's hazard note)."""
+        if not self.tracer.enabled:
+            return
+        multi = dist.process_count() > 1
+        if not multi and (self.metrics is None
+                          or not getattr(self.metrics, "active", True)):
+            return  # no sink would receive the record: skip building it
+        if multi and jax.default_backend() == "cpu":
+            # XLA:CPU hazard gate (see _save_checkpoint_inner): the
+            # gather below enqueues a collective program that must not
+            # queue behind the in-flight epoch's programs on the shared
+            # CPU thread pool.
+            jax.block_until_ready(self.state)
+        from ..obs.aggregate import epoch_straggler_record
+        epoch_straggler_record(self.tracer, self.mesh if multi else None,
+                               since, metrics=self.metrics, epoch=epoch)
 
     def _emergency_checkpoint(self, epoch: int) -> None:
         """Coordinated preemption exit: flush + verify the epoch's losses,
@@ -545,6 +624,11 @@ class Trainer:
             self.metrics.log_event("preemption_checkpoint", epoch=epoch,
                                    step=self._host_step,
                                    snapshot=self.snapshot_path)
+            # The records describing the run's final verified state must
+            # survive the SIGKILL that follows SIGTERM: line buffering
+            # only reaches the page cache — force the tail to DISK.
+            self.metrics.fsync()
+        self.tracer.flush(fsync=True)  # same durability for the span tail
         raise PreemptionInterrupt(epoch, self.snapshot_path)
 
     def train(self, max_epochs: int, epoch_callback=None) -> None:

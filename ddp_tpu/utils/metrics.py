@@ -1,23 +1,37 @@
-"""Per-step metrics logging.
+"""Run metrics logging — one sink for scalar curves AND discrete events.
 
-The reference never logs training loss (SURVEY.md §5: its only telemetry is
-the epoch-header print, multigpu.py:102, and end-of-run wall-clock/size/
-accuracy prints) — but loss-curve parity can't be measured without a loss
-stream, so the survey flags per-step loss emission as a required addition.
+The reference never logs training loss (SURVEY.md §5: its only telemetry
+is the epoch-header print, multigpu.py:102, and end-of-run wall-clock/
+size/accuracy prints) — but loss-curve parity can't be measured without a
+loss stream, so the survey flags per-step loss emission as a required
+addition.
 
-``MetricsLogger`` appends one JSON line per step: global step, epoch, loss,
-effective LR, wall-clock seconds since construction.  Process-0 only (the
-same gate as checkpoint writes, multigpu.py:118) — values are replicated
-across the mesh, so one writer suffices.
+``MetricsLogger`` appends one JSON line per record to ``path`` and, with
+``tensorboard_dir``, mirrors numeric curves as ``tf.summary`` scalars.
+Every record — per-step scalars (``log_step``), lifecycle events
+(``log_event``), live telemetry (``log_live``, fed by obs/live.py) and
+eval accuracy (``log_eval``) — goes through ONE internal ``_emit`` sink,
+so the JSONL file and the TensorBoard mirror can never diverge and every
+record carries the same ``wall_s`` clock.  That clock is
+``time.monotonic()`` since construction: an NTP slew or clock jump
+mid-run must not corrupt the one timeline all attribution hangs on
+(``time.time()`` deltas did exactly that before round 7).
 
-``tensorboard_dir`` additionally mirrors the stream as TensorBoard scalars
-(``train/loss``, ``train/lr``, ``eval/accuracy``) via ``tf.summary``;
-tensorflow is imported lazily and only when the option is used — the
-framework itself carries no tf dependency.
+Process-0 only (the same gate as checkpoint writes, multigpu.py:118) —
+values are replicated across the mesh, so one writer suffices.
+
+tensorflow is imported lazily and only when ``tensorboard_dir`` is used —
+the framework itself carries no tf dependency.
+
+Durability: the JSONL handle is line-buffered (a crash loses at most the
+in-flight line); :meth:`fsync` forces the tail to DISK and is called from
+the preemption emergency-checkpoint path, so the records describing the
+run's final verified state survive the SIGKILL that follows SIGTERM.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import IO, Optional
 
@@ -28,7 +42,9 @@ class MetricsLogger:
         self.path = path
         self._f: Optional[IO[str]] = None
         self._tb = None
-        self._t0 = time.time()
+        # Monotonic basis: wall_s must survive NTP slews / clock jumps
+        # (it is the timeline every cross-record attribution joins on).
+        self._t0 = time.monotonic()
         if not enabled:
             return
         if path:
@@ -43,29 +59,47 @@ class MetricsLogger:
             self._tf = tf
             self._tb = tf.summary.create_file_writer(tensorboard_dir)
 
-    def log_step(self, *, step: int, epoch: int, loss: float,
-                 lr: float) -> None:
+    @property
+    def active(self) -> bool:
+        """True when at least one sink (JSONL or TensorBoard) is open —
+        callers skip building telemetry no sink would receive."""
+        return self._f is not None or self._tb is not None
+
+    def _emit(self, rec: dict, scalars: Optional[dict] = None,
+              step: Optional[int] = None) -> None:
+        """THE sink: JSONL line (with the shared wall_s clock) plus the
+        optional TensorBoard scalar mirror.  Every public log_* method
+        lands here — one place for format, clock, and buffering policy."""
         if self._f is not None:
             self._f.write(json.dumps({
-                "step": step, "epoch": epoch, "loss": round(loss, 6),
-                "lr": round(lr, 8),
-                "wall_s": round(time.time() - self._t0, 3),
+                **rec, "wall_s": round(time.monotonic() - self._t0, 3),
             }) + "\n")
-        if self._tb is not None:
+        if self._tb is not None and scalars:
             with self._tb.as_default():
-                self._tf.summary.scalar("train/loss", loss, step=step)
-                self._tf.summary.scalar("train/lr", lr, step=step)
+                for tag, val in scalars.items():
+                    self._tf.summary.scalar(tag, val, step=step)
+
+    def log_step(self, *, step: int, epoch: int, loss: float,
+                 lr: float) -> None:
+        self._emit({"step": step, "epoch": epoch, "loss": round(loss, 6),
+                    "lr": round(lr, 8)},
+                   scalars={"train/loss": loss, "train/lr": lr}, step=step)
 
     def log_event(self, kind: str, **fields) -> None:
         """Resilience/lifecycle event record (preemption checkpoint,
-        fallback restore, non-finite loss, watchdog) — JSONL only; these
-        are discrete events, not scalar curves, so no TensorBoard mirror.
-        One line per event: ``{"event": kind, ...fields, "wall_s": t}``."""
-        if self._f is not None:
-            self._f.write(json.dumps({
-                "event": kind, **fields,
-                "wall_s": round(time.time() - self._t0, 3),
-            }) + "\n")
+        fallback restore, non-finite loss, watchdog, phase stragglers) —
+        JSONL only; these are discrete events, not scalar curves, so no
+        TensorBoard mirror.  One line per event:
+        ``{"event": kind, ...fields, "wall_s": t}``."""
+        self._emit({"event": kind, **fields})
+
+    def log_live(self, *, step: int, **fields) -> None:
+        """Live telemetry record (obs/live.py: rolling median/p90 step
+        time, samples/sec, MFU, prefetch occupancy) — JSONL plus a
+        ``live/<field>`` TensorBoard curve per numeric field."""
+        self._emit({"event": "live", "step": step, **fields},
+                   scalars={f"live/{k}": v for k, v in fields.items()
+                            if isinstance(v, (int, float))}, step=step)
 
     def log_eval(self, *, epoch: int, accuracy: float,
                  final: bool = False) -> None:
@@ -73,16 +107,18 @@ class MetricsLogger:
         ``final=True``, the end-of-run accuracy the reference prints
         (multigpu.py:247-248) — the run's headline metric, landed as the
         last record of the stream."""
+        rec = {"epoch": epoch, "eval_accuracy": round(accuracy, 4)}
+        if final:
+            rec["final"] = True
+        self._emit(rec, scalars={"eval/accuracy": accuracy}, step=epoch)
+
+    def fsync(self) -> None:
+        """Force the JSONL tail to disk — called from the preemption
+        emergency-checkpoint path so the event tail survives SIGTERM
+        (line buffering alone only reaches the OS page cache)."""
         if self._f is not None:
-            rec = {"epoch": epoch, "eval_accuracy": round(accuracy, 4),
-                   "wall_s": round(time.time() - self._t0, 3)}
-            if final:
-                rec["final"] = True
-            self._f.write(json.dumps(rec) + "\n")
-        if self._tb is not None:
-            with self._tb.as_default():
-                self._tf.summary.scalar("eval/accuracy", accuracy,
-                                        step=epoch)
+            self._f.flush()
+            os.fsync(self._f.fileno())
 
     def close(self) -> None:
         if self._f is not None:

@@ -183,16 +183,25 @@ def attribute_streaming(host_ms: float, h2d_ms: float, step_ms: float,
     """Pipeline-model decomposition of a streaming run's per-step wall time
     (the BASELINE.md streaming-gap table; VERDICT r5 weak #5 / next #4).
 
-    Inputs are the three stages measured in ISOLATION at the same shape —
-    host materialise+augment (``bench.py --pipeline``), H2D upload
-    (blocking device_put), steady-state device step — plus the measured
-    end-to-end streaming wall time per step.  In a perfectly overlapped
-    pipeline the wall time equals the SLOWEST stage (the others hide
-    behind it); everything above that floor is serialization the overlap
-    engine failed to hide — dispatch gap.  Returns the stage costs, the
-    bottleneck stage name, the pipeline floor, ``dispatch_gap_ms`` (wall −
-    floor, >= 0 up to measurement noise) and ``overlap_efficiency``
-    (floor / wall; 1.0 = every non-bottleneck stage fully hidden).
+    Inputs are the three stages measured in ISOLATION at the same shape
+    (sequential host materialise+augment, blocking H2D upload,
+    steady-state device step — the pipeline-floor model needs each
+    stage's uncontended cost; the same run's tracer spans ship alongside
+    as the record's ``phase_ms`` block, bench.py --stream_attr) plus the
+    measured end-to-end streaming wall time per step.  In a perfectly overlapped pipeline the
+    wall time equals the SLOWEST stage (the others hide behind it);
+    everything above that floor is serialization the overlap engine
+    failed to hide — dispatch gap.  Returns the stage costs, the
+    bottleneck stage name, the pipeline floor, ``dispatch_gap_ms`` and
+    ``overlap_efficiency`` (floor / wall; 1.0 = every non-bottleneck
+    stage fully hidden).
+
+    Edge discipline (measurement noise can put wall *below* the floor —
+    e.g. a floor stage timed on a colder cache than the real run): the
+    gap is CLAMPED at 0 and efficiency capped at 1.0, so a noisy sample
+    reads as "fully overlapped", never as a negative gap a trend
+    consumer would mis-sum; ``wall_ms <= 0`` (no steps ran) reports zero
+    efficiency and zero gap rather than dividing by it.
     """
     stages = {"host_augment_ms": host_ms, "h2d_ms": h2d_ms,
               "device_step_ms": step_ms}
@@ -203,8 +212,10 @@ def attribute_streaming(host_ms: float, h2d_ms: float, step_ms: float,
         "streaming_wall_ms": round(wall_ms, 3),
         "bottleneck": bottleneck,
         "pipeline_floor_ms": round(floor, 3),
-        "dispatch_gap_ms": round(wall_ms - floor, 3),
-        "overlap_efficiency": round(floor / wall_ms, 4) if wall_ms else 0.0,
+        "dispatch_gap_ms": round(max(wall_ms - floor, 0.0), 3)
+        if wall_ms > 0 else 0.0,
+        "overlap_efficiency": round(min(floor / wall_ms, 1.0), 4)
+        if wall_ms > 0 else 0.0,
     }
 
 

@@ -1,0 +1,475 @@
+"""Telemetry subsystem (ddp_tpu/obs/): span tracer, Perfetto export,
+live stats, straggler aggregation, and the CLI/e2e wiring — plus the
+profiling edge cases the round-7 satellites name (attribute_streaming
+clamping, categorize on full-definition-line op names)."""
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from ddp_tpu.obs import aggregate, export
+from ddp_tpu.obs.live import LiveStats, model_mfu
+from ddp_tpu.obs.tracer import (NullTracer, SpanTracer, get_tracer,
+                                set_tracer)
+
+# ---------------------------------------------------------------------------
+# tracer
+
+
+def test_tracer_records_spans_and_spills(tmp_path):
+    spill = str(tmp_path / "spill.jsonl")
+    tr = SpanTracer(spill_path=spill, host=3)
+    with tr.span("dispatch", step=7):
+        time.sleep(0.002)
+    with tr.span("host_augment", step=8, overlap=True):
+        pass
+    tr.close()
+    spans = tr.spans_since(0.0)
+    assert [s["phase"] for s in spans] == ["dispatch", "host_augment"]
+    assert spans[0]["step"] == 7 and spans[0]["dur_s"] >= 0.002
+    assert spans[0]["overlap"] is False and spans[1]["overlap"] is True
+    lines = [json.loads(l) for l in open(spill)]
+    assert len(lines) == 2
+    assert lines[0]["phase"] == "dispatch" and lines[0]["host"] == 3
+    assert lines[1]["overlap"] is True
+
+
+def test_tracer_aborted_span_not_recorded():
+    """A span whose body raises never lands — which is what makes 'last
+    completed span' the right stall diagnostic, and keeps the iterator-
+    exhaustion StopIteration probe from leaving a bogus record."""
+    tr = SpanTracer()
+    with pytest.raises(RuntimeError):
+        with tr.span("dispatch", step=0):
+            raise RuntimeError("boom")
+    assert tr.spans_since(0.0) == []
+    assert tr.describe_last() == "no spans completed"
+
+
+def test_tracer_ring_bounded_and_window():
+    tr = SpanTracer(ring=8)
+    for i in range(20):
+        with tr.span("dispatch", step=i):
+            pass
+    spans = tr.spans_since(0.0)
+    assert len(spans) == 8  # ring bound
+    assert [s["step"] for s in spans] == list(range(12, 20))
+    t_mid = tr.now()
+    with tr.span("eval"):
+        pass
+    assert [s["phase"] for s in tr.spans_since(t_mid)] == ["eval"]
+    last = tr.last_spans()
+    assert last["dispatch"]["step"] == 19
+    assert "eval" in tr.describe_last()
+
+
+def test_tracer_thread_safety():
+    tr = SpanTracer(ring=10_000)
+
+    def work(tid):
+        for i in range(200):
+            with tr.span("host_augment", step=i, overlap=True):
+                pass
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(tr.spans_since(0.0)) == 800
+
+
+def test_null_tracer_is_inert_and_default():
+    null = NullTracer()
+    with null.span("dispatch", step=1):
+        pass
+    assert null.spans_since(0.0) == [] and null.last_spans() == {}
+    assert not null.enabled
+    null.flush(fsync=True)
+    null.close()
+    # The process default is the NullTracer, and set/get round-trips.
+    assert not get_tracer().enabled
+    tr = SpanTracer()
+    set_tracer(tr)
+    try:
+        assert get_tracer() is tr
+    finally:
+        set_tracer(None)
+    assert not get_tracer().enabled
+
+
+# ---------------------------------------------------------------------------
+# export / report
+
+
+def _sample_spans():
+    return [
+        {"phase": "host_augment", "step": 0, "start_s": 0.0,
+         "dur_s": 0.010, "overlap": True, "host": 0},
+        {"phase": "data_wait", "step": 0, "start_s": 0.011,
+         "dur_s": 0.001, "overlap": False, "host": 0},
+        {"phase": "dispatch", "step": 0, "start_s": 0.012, "dur_s": 0.100,
+         "overlap": False, "host": 0},
+        {"phase": "dispatch", "step": 1, "start_s": 0.112, "dur_s": 0.300,
+         "overlap": False, "host": 0},
+        {"phase": "loss_flush", "step": 0, "start_s": 0.412, "dur_s": 0.05,
+         "overlap": False, "host": 0},
+        {"phase": "dispatch", "step": 2, "start_s": 0.1, "dur_s": 0.2,
+         "overlap": False, "host": 1},
+    ]
+
+
+def test_to_trace_events_schema_and_tracks():
+    trace = export.to_trace_events(_sample_spans())
+    n = export.validate_trace_events(trace)
+    assert n == len(trace["traceEvents"])
+    evs = trace["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 6
+    # One process per host...
+    assert {e["args"]["name"] for e in meta if e["name"] == "process_name"} \
+        == {"host 0", "host 1"}
+    # ...one named track per phase, same tid on every host.
+    tid_by_name = {}
+    for e in meta:
+        if e["name"] == "thread_name":
+            tid_by_name.setdefault(e["args"]["name"], set()).add(e["tid"])
+    assert all(len(tids) == 1 for tids in tid_by_name.values())
+    dispatch_tid = next(iter(tid_by_name["dispatch"]))
+    assert all(e["tid"] == dispatch_tid for e in xs
+               if e["name"] == "dispatch")
+    # ts/dur in microseconds, step in args.
+    d0 = next(e for e in xs if e["name"] == "dispatch" and e["pid"] == 0
+              and e["args"]["step"] == 0)
+    assert d0["ts"] == pytest.approx(0.012e6) and \
+        d0["dur"] == pytest.approx(0.1e6)
+
+
+def test_validate_trace_events_rejects_malformed():
+    good = export.to_trace_events(_sample_spans())
+    with pytest.raises(ValueError):
+        export.validate_trace_events({"no": "traceEvents"})
+    with pytest.raises(ValueError):
+        export.validate_trace_events({"traceEvents": []})
+    bad = json.loads(json.dumps(good))
+    bad["traceEvents"][-1]["ts"] = -5.0
+    with pytest.raises(ValueError, match="ts"):
+        export.validate_trace_events(bad)
+    bad2 = json.loads(json.dumps(good))
+    bad2["traceEvents"][0]["ph"] = "B"
+    with pytest.raises(ValueError, match="ph"):
+        export.validate_trace_events(bad2)
+
+
+def test_read_spill_merges_and_skips_torn_tail(tmp_path):
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_text(json.dumps({"phase": "dispatch", "step": 0,
+                             "start_s": 1.0, "dur_s": 0.1}) + "\n"
+                 + '{"phase": "dispatch", "st')  # torn tail (SIGKILL)
+    b.write_text(json.dumps({"phase": "eval", "step": None, "start_s": 0.5,
+                             "dur_s": 0.2, "host": 1,
+                             "overlap": False}) + "\n")
+    spans = export.read_spill([str(a), str(b)])
+    assert [s["phase"] for s in spans] == ["eval", "dispatch"]  # sorted
+    assert spans[1]["host"] == 0 and spans[1]["overlap"] is False  # defaults
+
+
+def test_phase_summary_separates_serial_from_overlap():
+    rows, wall_s, critical_s = export.phase_summary(_sample_spans())
+    by = {(r["phase"], r["overlap"]): r for r in rows}
+    assert by[("host_augment", True)]["count"] == 1
+    assert by[("dispatch", False)]["count"] == 3
+    # Serial sum excludes the overlapped producer span.
+    assert critical_s == pytest.approx(0.001 + 0.1 + 0.3 + 0.05 + 0.2)
+    assert wall_s == pytest.approx(0.462)  # 0.0 .. 0.412+0.05
+
+
+def test_step_walls_and_slowest_steps():
+    # Per-step grouping is a per-host operation (format_report filters by
+    # host first — hosts have independent clocks and their serial lanes
+    # each tile their own wall); loss_flush (boundary phase) and the
+    # overlap host_augment span are excluded from the grouping.
+    host0 = [s for s in _sample_spans() if s["host"] == 0]
+    walls = export.step_walls(host0)
+    assert walls[0]["total"] == pytest.approx(101.0)  # data_wait + dispatch
+    assert walls[1]["total"] == pytest.approx(300.0)
+    top = export.slowest_steps(host0, 2)
+    assert [s for s, _ in top] == [1, 0]
+    report = export.format_report(_sample_spans(), top=3, bins=4)
+    assert "phase sum (serial lanes)" in report
+    assert "slowest" in report and "histogram" in report
+    # Multi-host spills report per host — no pooled double-counting.
+    assert "=== host 0" in report and "=== host 1" in report
+
+
+# ---------------------------------------------------------------------------
+# profiling satellites: attribute_streaming edges + categorize bare names
+
+
+def test_attribute_streaming_clamps_wall_below_floor():
+    """Measurement noise can put the streaming wall BELOW the slowest
+    isolated stage; the gap must clamp to 0 and efficiency cap at 1.0
+    (a negative gap would mis-sum in trend consumers)."""
+    from ddp_tpu.utils.profiling import attribute_streaming
+    attr = attribute_streaming(1.0, 2.0, 210.0, 100.0)
+    assert attr["bottleneck"] == "device_step_ms"
+    assert attr["pipeline_floor_ms"] == 210.0
+    assert attr["dispatch_gap_ms"] == 0.0
+    assert attr["overlap_efficiency"] == 1.0
+    # The normal case is unchanged.
+    attr2 = attribute_streaming(1.0, 2.0, 100.0, 125.0)
+    assert attr2["dispatch_gap_ms"] == pytest.approx(25.0)
+    assert attr2["overlap_efficiency"] == pytest.approx(0.8)
+
+
+def test_attribute_streaming_zero_wall():
+    from ddp_tpu.utils.profiling import attribute_streaming
+    attr = attribute_streaming(1.0, 2.0, 3.0, 0.0)
+    assert attr["overlap_efficiency"] == 0.0
+    assert attr["dispatch_gap_ms"] == 0.0
+    assert attr["pipeline_floor_ms"] == 3.0
+
+
+def test_categorize_full_definition_line_operand_pollution():
+    """Full-definition-line op names: classification keys on the op's own
+    bare name, never on operand names — a fusion CONSUMING a copy-done
+    or a convolution operand is neither a copy nor a conv."""
+    from ddp_tpu.utils.profiling import categorize
+    ops = [
+        ("%fusion.2 = (f32[128]) fusion(%copy-done.57, %convolution.3)",
+         10.0, 1.0),
+        ("%copy.9 = f32[8] copy(%fusion.4)", 4.0, 0.4),
+        # conv_ops reclassification must also see the BARE name when the
+        # trace hands back a full definition line.
+        ("%fusion.164 = (f32[64]) fusion(%param.1)", 8.0, 0.8),
+    ]
+    conv_ops = {"fusion.164": "conv (fused, kind per HLO)"}
+    got = {label: per for label, _, per in categorize(ops, conv_ops)}
+    assert got["elementwise/reduction fusions"] == 1.0
+    assert got["layout copies / bitcasts"] == 0.4
+    assert got["conv (fused, kind per HLO)"] == 0.8
+
+
+# ---------------------------------------------------------------------------
+# live stats
+
+
+class _FakeMetrics:
+    def __init__(self):
+        self.records = []
+
+    def log_live(self, *, step, **fields):
+        self.records.append({"step": step, **fields})
+
+
+def test_live_stats_window_and_mfu():
+    m = _FakeMetrics()
+    live = LiveStats(m, global_batch=512, n_chips=1, log_every=4,
+                     window=8, model="vgg", device_kind="TPU v5 lite")
+    for i in range(8):
+        live.step(0.100 if i != 5 else 0.500, step=i + 1)
+    assert [r["step"] for r in m.records] == [4, 8]
+    rec = m.records[-1]
+    assert rec["step_ms_median"] == pytest.approx(100.0)
+    assert rec["step_ms_p90"] == pytest.approx(500.0)
+    assert rec["samples_per_sec"] == pytest.approx(5120.0)
+    # MFU against the single-home FLOP/peak tables (obs/live.py).
+    assert rec["mfu"] == pytest.approx(
+        model_mfu(5120.0, "vgg", "TPU v5 lite"), abs=1e-3)
+    # Unknown device kind -> no mfu field rather than a wrong one.
+    m2 = _FakeMetrics()
+    live2 = LiveStats(m2, global_batch=8, n_chips=1, log_every=1,
+                      model="vgg", device_kind="CPU")
+    live2.step(0.01, step=1)
+    assert "mfu" not in m2.records[0]
+
+
+def test_live_stats_prefetch_occupancy():
+    from ddp_tpu.data import PrefetchStats
+    m = _FakeMetrics()
+    ps = PrefetchStats()
+    live = LiveStats(m, global_batch=8, n_chips=2, log_every=2,
+                     prefetch_stats=ps)
+    ps._add("wait_s", 0.004)
+    ps._add("host_s", 0.02)
+    ps.count_batch()
+    ps.count_batch()
+    live.step(0.1, step=1)
+    live.step(0.1, step=2)
+    rec = m.records[0]
+    assert rec["prefetch_wait_ms_per_step"] == pytest.approx(2.0)
+    assert rec["prefetch_host_ms_per_step"] == pytest.approx(10.0)
+    assert 0.0 <= rec["prefetch_occupancy"] <= 1.0
+    # Differential sampling: a second window with no new waits is clean.
+    live.step(0.1, step=3)
+    live.step(0.1, step=4)
+    assert m.records[1]["prefetch_occupancy"] == 1.0
+
+
+def test_step_walls_replay_latest_trajectory_wins():
+    """--on_nan restore replays steps under the same global ids; the
+    per-step report must describe the latest trajectory, not sum both
+    into a fake 2x straggler."""
+    spans = [
+        {"phase": "h2d", "step": 5, "start_s": 0.9, "dur_s": 0.004,
+         "overlap": False, "host": 0},
+        {"phase": "dispatch", "step": 5, "start_s": 1.0, "dur_s": 0.100,
+         "overlap": False, "host": 0},
+        # ... restore rewinds; step 5 replays (same phases, new times):
+        {"phase": "h2d", "step": 5, "start_s": 8.9, "dur_s": 0.002,
+         "overlap": False, "host": 0},
+        {"phase": "dispatch", "step": 5, "start_s": 9.0, "dur_s": 0.150,
+         "overlap": False, "host": 0},
+    ]
+    walls = export.step_walls(spans)
+    # The replayed trajectory only — not old+new summed (254 ms).
+    assert walls[5]["total"] == pytest.approx(152.0)
+    assert walls[5]["dispatch"] == pytest.approx(150.0)
+    assert walls[5]["h2d"] == pytest.approx(2.0)
+
+
+def test_threaded_prefetch_no_phantom_sentinel_span():
+    """The threaded engine's final queue get returns the end-of-stream
+    sentinel, not a batch — it must not record a data_wait span numbered
+    as the NEXT epoch's first step (it would double-count into that step
+    in the per-step reports)."""
+    import numpy as np
+
+    from ddp_tpu.data.prefetch import prefetch_to_device
+    from ddp_tpu.parallel import make_mesh
+
+    mesh = make_mesh(1)
+    batches = iter([{"image": np.zeros((1, 2, 2, 3), np.float32),
+                     "label": np.zeros((1,), np.int32)} for _ in range(3)])
+    tr = SpanTracer()
+    out = list(prefetch_to_device(batches, mesh, depth=2,
+                                  shard_fn=lambda b, m: b, tracer=tr,
+                                  step0=10))
+    assert len(out) == 3
+    waits = [s for s in tr.spans_since(0.0) if s["phase"] == "data_wait"]
+    assert [s["step"] for s in waits] == [10, 11, 12]  # no step-13 phantom
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+
+
+def test_phase_medians_and_straggler_report():
+    spans = [{"phase": "dispatch", "dur_s": d / 1e3} for d in (10, 20, 30)]
+    spans += [{"phase": "h2d", "dur_s": 0.004}]
+    med = aggregate.phase_medians(spans)
+    assert med["dispatch"] == pytest.approx(20.0)
+    assert med["h2d"] == pytest.approx(4.0)
+    report = aggregate.straggler_report(med)  # single-host identity
+    assert report["dispatch"] == {"slowest_host": 0, "slowest_ms": 20.0,
+                                  "median_ms": 20.0, "skew_pct": 0.0}
+    assert "eval" not in report  # untimed phases omitted
+    # Record shape survives the tracer round trip.
+    tr = SpanTracer()
+    with tr.span("dispatch", step=0):
+        pass
+    rec = aggregate.epoch_straggler_record(tr, None, 0.0,
+                                           metrics=None, epoch=0)
+    assert set(rec) == {"dispatch"}
+    assert aggregate.epoch_straggler_record(NullTracer(), None, 0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# watchdog stall context
+
+
+def test_watchdog_stall_report_includes_last_spans(capsys):
+    from ddp_tpu.resilience.watchdog import Watchdog
+    fired = []
+    wd = Watchdog(0.2, tag="obs-unit",
+                  context=lambda: "dispatch[step 41] ended @1.0s")
+    wd._exit = fired.append  # seam: don't kill pytest
+    wd.start()
+    try:
+        time.sleep(0.2 * 4)
+    finally:
+        wd.stop()
+    assert fired == [124]
+    err = capsys.readouterr().err
+    assert "last completed spans on this host" in err
+    assert "dispatch[step 41]" in err
+
+
+# ---------------------------------------------------------------------------
+# e2e: the CLI wiring, the obs CLI, and the --obs_off kill-switch
+
+
+_E2E_ARGV = ["2", "1", "--batch_size", "8", "--synthetic", "--model",
+             "deepnn", "--lr", "0.02", "--num_devices", "2",
+             "--synthetic_size", "64", "--metrics_path", "m.jsonl",
+             "--log_every", "2"]
+
+
+def test_cli_default_run_spills_and_reports(tmp_path, capsys, monkeypatch):
+    """The acceptance loop: a default-flag run produces a spill file;
+    ``python -m ddp_tpu.obs`` renders the phase table with a sane
+    serial-sum-vs-wall identity; the Perfetto export schema-validates;
+    live records carry the prefetch occupancy (satellite: PrefetchStats
+    no longer dies with the engine object); each epoch logs a
+    phase_stragglers record."""
+    from ddp_tpu import cli
+    from ddp_tpu.obs.__main__ import main as obs_main
+
+    monkeypatch.chdir(tmp_path)
+    args = cli.build_parser("t").parse_args(_E2E_ARGV)
+    cli.run(args, num_devices=None)
+    capsys.readouterr()
+    assert (tmp_path / "trace_spill.jsonl").exists()
+    # The run restored the process default tracer on exit.
+    assert not get_tracer().enabled
+
+    # Metrics stream: live records with prefetch occupancy + stragglers.
+    recs = [json.loads(l) for l in open("m.jsonl")]
+    live = [r for r in recs if r.get("event") == "live"]
+    assert live, "no live records despite --log_every"
+    assert all("step_ms_median" in r and "samples_per_sec" in r
+               for r in live)
+    assert any("prefetch_occupancy" in r and
+               "prefetch_wait_ms_per_step" in r for r in live)
+    stragglers = [r for r in recs if r.get("event") == "phase_stragglers"]
+    assert [r["epoch"] for r in stragglers] == [0, 1]
+    assert "dispatch" in stragglers[0]["phases"]
+    # wall_s rides on every record (the shared monotonic clock).
+    assert all("wall_s" in r for r in recs)
+
+    # The obs CLI: phase table + histogram + slowest-K + Perfetto export.
+    rc = obs_main(["trace_spill.jsonl", "--perfetto", "trace.json",
+                   "--top", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "dispatch" in out and "slowest" in out
+    m = re.search(r"phase sum \(serial lanes\): ([0-9.]+) ms = "
+                  r"([0-9.]+)% of wall", out)
+    assert m, out
+    # The identity the acceptance pins at within-10% on a quiet box;
+    # loose bounds here to keep CI noise-immune.
+    assert 50.0 <= float(m.group(2)) <= 120.0
+    n = export.validate_trace_events(json.load(open("trace.json")))
+    assert n > 0
+
+
+def test_cli_obs_off_emits_nothing(tmp_path, capsys, monkeypatch):
+    """--obs_off is a true kill-switch: no spill file, no live records,
+    no straggler events — the metrics loss stream itself stays."""
+    from ddp_tpu import cli
+
+    monkeypatch.chdir(tmp_path)
+    # A stale spill from an earlier traced run must not survive an
+    # --obs_off run — the obs CLI would silently report the wrong run.
+    (tmp_path / "trace_spill.jsonl").write_text('{"stale": true}\n')
+    args = cli.build_parser("t").parse_args(_E2E_ARGV + ["--obs_off"])
+    cli.run(args, num_devices=None)
+    capsys.readouterr()
+    assert not (tmp_path / "trace_spill.jsonl").exists()
+    recs = [json.loads(l) for l in open("m.jsonl")]
+    assert not any(r.get("event") in ("live", "phase_stragglers")
+                   for r in recs)
+    assert any("loss" in r for r in recs)  # the loss stream is untouched
