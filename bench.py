@@ -198,17 +198,52 @@ def _parse_args():
                         "CIFAR-10 epoch length and amortises the "
                         "per-epoch dispatch the 16-step default "
                         "overstates)")
+    p.add_argument("--serve", action="store_true",
+                   help="Load-generate against the serving stack "
+                        "(ddp_tpu/serve/): closed-loop capacity probe, "
+                        "then an open-loop offered-load sweep recording "
+                        "p50/p90/p99 latency + achieved throughput per "
+                        "point and locating the saturation knee — the "
+                        "latency-vs-load curve a capacity plan reads")
+    p.add_argument("--serve_loads", default="auto", metavar="R1,R2,...",
+                   help="Offered loads (requests/sec) for the open-loop "
+                        "sweep; 'auto' derives 4 points bracketing the "
+                        "measured closed-loop capacity (0.4/0.7/1.0/"
+                        "1.3x) so the knee is inside the sweep by "
+                        "construction")
+    p.add_argument("--serve_secs", default=4.0, type=float,
+                   help="Seconds per load point (default 4)")
+    p.add_argument("--serve_buckets", default="1,8,32,128",
+                   help="Engine padded-batch bucket set (compiled once "
+                        "at startup; default 1,8,32,128)")
+    p.add_argument("--serve_max_wait_ms", default=5.0, type=float,
+                   help="Batch-forming wait budget (default 5 ms)")
+    p.add_argument("--serve_queue_depth", default=256, type=int,
+                   help="Admission queue bound (default 256)")
+    p.add_argument("--serve_conc", default=8, type=int,
+                   help="Closed-loop concurrent clients (default 8)")
+    p.add_argument("--serve_rows", default=1, type=int,
+                   help="Image rows per request (default 1 — the "
+                        "single-user online shape)")
+    p.add_argument("--snapshot_path", default=None,
+                   help="With --serve: serve this trained checkpoint "
+                        "(head path or directory) instead of fresh-init "
+                        "weights — the full lineage-load path bench")
     return p.parse_args()
 
 
 def main() -> None:
     args = _parse_args()
     if args.dump_hlo and (args.sweep or args.pipeline or args.e2e
-                          or args.batch_sweep or args.stream_attr):
+                          or args.batch_sweep or args.stream_attr
+                          or args.serve):
         raise SystemExit("--dump_hlo only applies to the steady-state step "
                          "bench (it dumps the timed step/scan program); it "
                          "has no program to dump in --sweep/--batch_sweep/"
-                         "--pipeline/--e2e/--stream_attr modes")
+                         "--pipeline/--e2e/--stream_attr/--serve modes")
+    if args.serve:
+        _bench_serve(args)
+        return
     if args.batch_sweep:
         _bench_batch_sweep(args)
         return
@@ -652,6 +687,210 @@ def _bench_stream_attr(args) -> None:
                      **pstats.per_step_ms()},
         "window_epoch_s": [round(d, 3) for d in dts],
     }))
+
+
+def _bench_serve(args) -> None:
+    """Serving latency/throughput vs offered load (ddp_tpu/serve/).
+
+    Two measurements around one in-process engine + dynamic batcher (the
+    HTTP layer is deliberately out of the loop: stdlib JSON parsing
+    would dominate on a CPU box and the queue/batch/forward pipeline is
+    the part this framework owns):
+
+    1. CLOSED loop — ``--serve_conc`` clients submitting back-to-back:
+       the capacity probe (max sustainable req/s at this request shape).
+    2. OPEN loop — fixed-rate arrivals at each ``--serve_loads`` point
+       (quasi-open: a bounded submitter pool, so at saturation arrivals
+       backlog instead of spawning unbounded threads — standard load-gen
+       practice), recording p50/p90/p99 latency, achieved throughput,
+       and shed count per point.
+
+    The saturation KNEE is the last offered point the stack still serves
+    at >=95% of the offered rate with nothing shed; the headline value is
+    the achieved throughput there.  'auto' loads bracket the measured
+    capacity (0.4/0.7/1.0/1.3x) so the knee is inside the sweep by
+    construction — and the compiled-executable count is asserted against
+    the resolved bucket-set size in the record itself (the bounded-
+    compile contract, ddp_tpu/serve/engine.py).
+    """
+    import threading
+
+    from ddp_tpu.serve import DynamicBatcher, QueueFull, ServeEngine
+    from ddp_tpu.serve.batcher import percentiles
+
+    mesh = make_mesh(args.num_devices)
+    model = get_model(args.model)
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
+    buckets = [int(b) for b in args.serve_buckets.split(",") if b]
+    if args.snapshot_path:
+        engine = ServeEngine.from_checkpoint(
+            args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
+            compute_dtype=compute_dtype)
+    else:
+        params, stats = model.init(jax.random.key(0))
+        engine = ServeEngine(model, params, stats, mesh, buckets=buckets,
+                             compute_dtype=compute_dtype)
+    t0 = time.perf_counter()
+    compiled = engine.warm()
+    warm_s = time.perf_counter() - t0
+    assert compiled <= len(engine.buckets), \
+        f"compile bound broken: {compiled} > {len(engine.buckets)}"
+    if not 1 <= args.serve_rows <= engine.max_rows:
+        # Fail HERE with the real reason: inside the load loops the same
+        # admission error would kill every client thread and surface as
+        # a ZeroDivisionError from a measured capacity of 0.
+        raise SystemExit(
+            f"--serve_rows {args.serve_rows} does not fit the engine's "
+            f"buckets (largest {engine.max_rows}); every request would "
+            "be rejected at admission")
+    batcher = DynamicBatcher(engine, max_wait_ms=args.serve_max_wait_ms,
+                             queue_depth=args.serve_queue_depth).start()
+    rng = np.random.default_rng(0)
+    req = rng.integers(0, 256,
+                       (args.serve_rows, 32, 32, 3)).astype(np.uint8)
+
+    def closed_loop(conc: int, secs: float) -> dict:
+        stop = time.perf_counter() + secs
+        lat: list = []
+        timeouts = [0]
+        lock = threading.Lock()
+
+        def client():
+            # A timed-out request must not kill the client thread —
+            # a silently-dead client stops offering load and the record
+            # would understate capacity with no sign anything went wrong.
+            while time.perf_counter() < stop:
+                t = time.perf_counter()
+                try:
+                    batcher.submit(req, timeout=30)
+                except TimeoutError:
+                    with lock:
+                        timeouts[0] += 1
+                    continue
+                dt = (time.perf_counter() - t) * 1e3
+                with lock:
+                    lat.append(dt)
+
+        threads = [threading.Thread(target=client) for _ in range(conc)]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t_start
+        return {"clients": conc, "requests": len(lat),
+                "throughput_rps": round(len(lat) / wall, 2),
+                "timed_out": timeouts[0],
+                "latency_ms": {k: (round(v, 3) if v is not None else None)
+                               for k, v in percentiles(lat).items()}}
+
+    def open_loop(rate: float, secs: float) -> dict:
+        n = max(int(rate * secs), 8)
+        base = time.perf_counter() + 0.05
+        arrivals = [base + i / rate for i in range(n)]
+        lat: list = []
+        shed = 0
+        timed_out = 0
+        counter = iter(range(n))
+        lock = threading.Lock()
+
+        def client():
+            nonlocal shed, timed_out
+            while True:
+                with lock:
+                    i = next(counter, None)
+                if i is None:
+                    return
+                delay = arrivals[i] - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                t = time.perf_counter()
+                try:
+                    batcher.submit(req, timeout=30)
+                except QueueFull:
+                    with lock:
+                        shed += 1
+                    continue
+                except TimeoutError:  # counted, never a dead client
+                    with lock:
+                        timed_out += 1
+                    continue
+                dt = (time.perf_counter() - t) * 1e3
+                with lock:
+                    lat.append(dt)
+
+        pool = [threading.Thread(target=client)
+                for _ in range(min(128, n))]
+        t_start = time.perf_counter()
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        wall = max(time.perf_counter() - t_start - 0.05, 1e-9)
+        return {"offered_rps": round(rate, 2), "requests": n,
+                "achieved_rps": round(len(lat) / wall, 2),
+                "shed": shed,
+                "timed_out": timed_out,
+                "latency_ms": {k: (round(v, 3) if v is not None else None)
+                               for k, v in percentiles(lat).items()}}
+
+    closed = closed_loop(args.serve_conc, args.serve_secs)
+    capacity = closed["throughput_rps"]
+    if capacity <= 0:
+        raise SystemExit(
+            "closed-loop capacity probe served 0 requests in "
+            f"{args.serve_secs}s (all timed out?); no load sweep to run "
+            "— raise --serve_secs or check the engine")
+    if args.serve_loads == "auto":
+        # Wide bracket: dynamic batching serves ABOVE the closed-loop
+        # probe (bigger formed batches amortise dispatch), so the sweep
+        # must reach well past it for the knee to be interior.
+        loads = [round(capacity * f, 2)
+                 for f in (0.4, 0.7, 1.0, 1.5, 2.25)]
+    else:
+        loads = [float(x) for x in args.serve_loads.split(",")]
+    open_points = [open_loop(r, args.serve_secs) for r in sorted(loads)]
+
+    knee = None
+    for pt in open_points:  # ascending offered load
+        if pt["shed"] == 0 and pt["timed_out"] == 0 and \
+                pt["achieved_rps"] >= 0.95 * pt["offered_rps"]:
+            knee = pt
+    rows_per_req = args.serve_rows
+    # The unit must say what the number IS: when no sweep point
+    # qualifies as the knee (every point shed or degraded — e.g. an
+    # explicit --serve_loads entirely past saturation), the headline is
+    # the most-saturated point's throughput, and calling that a knee
+    # would poison cross-round BENCH comparisons.
+    print(json.dumps({
+        "metric": f"{args.model} serve latency/throughput vs offered load "
+                  f"(batch buckets {list(engine.buckets)}, "
+                  f"{rows_per_req} row(s)/request, "
+                  f"{'bf16' if args.bf16 else 'fp32'}, "
+                  f"{mesh.devices.size} chip(s), max_wait "
+                  f"{args.serve_max_wait_ms} ms)",
+        "value": (knee or open_points[-1])["achieved_rps"],
+        "unit": ("req/s at the saturation knee (last offered point "
+                 "served >=95% with nothing shed)" if knee is not None
+                 else "req/s at the MOST-SATURATED sweep point (no knee "
+                      "inside the sweep: every offered point shed or "
+                      "degraded; not comparable to knee records)"),
+        "vs_baseline": 1.0,
+        "serve": {
+            "closed_loop": closed,
+            "open_loop": open_points,
+            "knee_offered_rps": (knee or {}).get("offered_rps"),
+            "samples_per_sec_at_knee": round(
+                (knee or open_points[-1])["achieved_rps"] * rows_per_req,
+                2),
+            "compiled_executables": compiled,
+            "bucket_set_size": len(engine.buckets),
+            "warm_compile_s": round(warm_s, 2),
+            "engine": engine.stats(),
+            "batcher": batcher.stats(),
+        },
+    }))
+    batcher.drain(timeout=10.0)
 
 
 def _bench_sweep(args) -> None:

@@ -23,6 +23,9 @@ Package layout
                 replacement for DDP/NCCL, multigpu.py:24-33, 89).
 - ``train/``    Trainer engine (singlegpu.py:85-128), evaluation
                 (singlegpu.py:184-209), checkpoint save/restore.
+- ``serve/``    inference serving: dynamic micro-batcher over bucketed
+                AOT-warmed eval forwards, stdlib HTTP front end
+                (``python -m ddp_tpu.serve``; no reference analogue).
 - ``utils/``    model-size reporting (singlegpu.py:212-225), torch interop
                 for parity tests, metrics logging.
 """

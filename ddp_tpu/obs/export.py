@@ -29,17 +29,25 @@ import statistics
 from typing import Dict, Iterable, List, Optional, Tuple
 
 # Canonical phase order: consumer-loop phases first in pipeline order,
-# then the boundary/background phases.  Unknown phases sort after these
-# (the tracer accepts free-form names).
+# then the boundary/background phases, then the serving engine's batch
+# pipeline (ddp_tpu/serve/ — queue_wait is per-request and overlap=True;
+# batch_form..d2h are the engine thread's serial stages, sharing "h2d"
+# with the training pipeline).  Unknown phases sort after these (the
+# tracer accepts free-form names).
 PHASE_ORDER = ("data_wait", "host_augment", "h2d", "dispatch",
-               "loss_flush", "ckpt_write", "eval")
+               "loss_flush", "ckpt_write", "eval",
+               "queue_wait", "batch_form", "pad", "forward", "d2h")
 
 # Phases attributable to ONE step each — the per-step wall decomposition
 # the histogram and slowest-K tables are built from.  Boundary phases
 # (loss_flush covers a whole epoch's steps, ckpt_write/eval a whole
-# epoch) stay in the phase table but not in per-step grouping.
+# epoch) stay in the phase table but not in per-step grouping.  On serve
+# spills a "step" is one formed batch (the engine's sequence number), so
+# the serving stages join the set — the two workloads never mix phases
+# in one spill, so neither pollutes the other's decomposition.
 PER_STEP_PHASES = frozenset(("data_wait", "host_augment", "h2d",
-                             "dispatch"))
+                             "dispatch",
+                             "batch_form", "pad", "forward", "d2h"))
 
 
 def _phase_rank(phase: str) -> tuple:

@@ -31,7 +31,8 @@ Exit-status contract (a restart wrapper keys off these):
   else a real failure; inspect before relaunching
 """
 from .guard import NonFiniteLossError, StepHealthGuard
-from .lineage import CheckpointLineage, load_latest_verifiable
+from .lineage import (CheckpointLineage, latest_verifiable,
+                      load_latest_verifiable)
 from .preemption import (EMERGENCY_CHECKPOINT_EXIT_STATUS, PreemptionGuard,
                          PreemptionInterrupt)
 from .watchdog import WATCHDOG_EXIT_STATUS, Watchdog
@@ -40,5 +41,5 @@ __all__ = [
     "CheckpointLineage", "EMERGENCY_CHECKPOINT_EXIT_STATUS",
     "NonFiniteLossError", "PreemptionGuard", "PreemptionInterrupt",
     "StepHealthGuard", "WATCHDOG_EXIT_STATUS", "Watchdog",
-    "load_latest_verifiable",
+    "latest_verifiable", "load_latest_verifiable",
 ]

@@ -212,9 +212,37 @@ def _candidates(path: str) -> List[Tuple[str, Optional[str]]]:
     return out
 
 
-def load_latest_verifiable(
+def _resolve_head(path: str) -> str:
+    """Accept either a head checkpoint path or a *directory* holding one.
+
+    The serve engine is pointed at "where training checkpoints land",
+    which operationally is a directory at least as often as a file.  A
+    directory resolves to the head its manifest names; without a manifest
+    the reference's fixed default ``checkpoint.pt`` (multigpu.py:111) is
+    assumed.  Ambiguity (several ``*.manifest.json`` heads in one
+    directory) is an error, not a guess — serving the wrong model must
+    not be a silent outcome.
+    """
+    if not os.path.isdir(path):
+        return path
+    manifests = sorted(glob.glob(os.path.join(glob.escape(path),
+                                              "*" + MANIFEST_SUFFIX)))
+    if len(manifests) > 1:
+        raise CheckpointError(
+            f"checkpoint directory {path!r} holds {len(manifests)} lineage "
+            f"manifests ({[os.path.basename(m) for m in manifests]}); pass "
+            "the head checkpoint path explicitly")
+    if manifests:
+        return manifests[0][:-len(MANIFEST_SUFFIX)]
+    return os.path.join(path, "checkpoint.pt")
+
+
+def latest_verifiable(
         path: Optional[str]) -> Optional[Tuple[Checkpoint, str]]:
-    """Restore the newest verifiable checkpoint under head path ``path``.
+    """Restore the newest verifiable checkpoint under ``path`` — the ONE
+    manifest-walking selection both the trainer's resume and the serve
+    engine's model load go through (a head checkpoint path, or a
+    directory resolved by :func:`_resolve_head`).
 
     Tries the head first, then each retained snapshot newest-first.  A
     candidate whose manifest sha256 mismatches is logged and still
@@ -230,6 +258,7 @@ def load_latest_verifiable(
     """
     if not path:
         return None
+    path = _resolve_head(path)
     cands = _candidates(path)
     tried: List[Tuple[str, str]] = []
     for fp, expected_sha in cands:
@@ -263,3 +292,8 @@ def load_latest_verifiable(
     raise CheckpointError(
         f"no verifiable checkpoint under {path!r}; candidates tried: "
         + "; ".join(f"{fp!r}: {why}" for fp, why in tried))
+
+
+# Historical name (rounds 5-7); the trainer and serve engine both call
+# latest_verifiable now, but external embedders may hold this spelling.
+load_latest_verifiable = latest_verifiable

@@ -1,0 +1,42 @@
+"""Serving subsystem — the framework's first non-training workload.
+
+The ROADMAP north star ("serve heavy traffic from millions of users")
+needs a path from a trainer checkpoint to answered prediction requests.
+This package is that path, built from the same parts training runs on:
+
+- ``engine``   :class:`ServeEngine` — the newest *verifiable* checkpoint
+               (``resilience.lineage.latest_verifiable``, the trainer's
+               own resume walk), eval-mode jitted forwards for a bounded
+               set of padded batch buckets over the ``parallel.mesh``
+               data axis, AOT-warmed at startup so no request ever pays
+               a compile.  The per-shard forward is
+               ``train.step.make_eval_apply`` — the exact function
+               ``evaluate()`` traces, so served logits cannot drift from
+               the training-loop evaluation of the same checkpoint.
+- ``batcher``  :class:`DynamicBatcher` — bounded admission queue,
+               batches formed on ``max_batch``-or-``max_wait_ms``
+               (whichever first), explicit backpressure
+               (:class:`QueueFull`) instead of unbounded latency,
+               graceful drain for shutdown.
+- ``http``     stdlib-only threaded HTTP front end: ``/predict``,
+               ``/healthz``, ``/stats``.
+- ``__main__`` ``python -m ddp_tpu.serve`` — stand the stack up on a
+               checkpoint; SIGTERM drains via the resilience preemption
+               guard.
+
+Every stage (queue_wait, batch_form, pad, h2d, forward, d2h) records
+``obs.tracer`` spans, so ``python -m ddp_tpu.obs`` and the Perfetto
+export explain a serve run exactly as they do a training run; the load
+generator lives in ``bench.py --serve`` (open- and closed-loop, latency
+percentiles vs offered load, saturation knee).
+"""
+from .batcher import Draining, DynamicBatcher, QueueFull, percentiles
+from .engine import (RequestTooLarge, ServeEngine, ServeError,
+                     resolve_buckets)
+from .http import ServeHTTPServer
+
+__all__ = [
+    "Draining", "DynamicBatcher", "QueueFull", "RequestTooLarge",
+    "ServeEngine", "ServeError", "ServeHTTPServer", "percentiles",
+    "resolve_buckets",
+]

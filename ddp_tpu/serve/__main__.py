@@ -1,0 +1,165 @@
+"""``python -m ddp_tpu.serve`` — stand up a model server on a checkpoint.
+
+Loads the newest verifiable checkpoint (the trainer's own lineage walk),
+AOT-compiles one eval forward per padded batch bucket, and serves
+``/predict`` / ``/healthz`` / ``/stats`` over a stdlib threaded HTTP
+server fronted by the dynamic micro-batcher.  SIGTERM/SIGINT drain
+gracefully through the resilience preemption guard: admission stops
+(503 + draining healthz), accepted requests finish, the span spill is
+flushed, exit 0.  A second signal kills immediately (the guard's
+standard escape hatch).
+
+Usage:
+    python multigpu.py 5 1 --snapshot_path ck.pt        # train
+    python -m ddp_tpu.serve --snapshot_path ck.pt --port 8100
+    curl -s localhost:8100/healthz
+    curl -s -X POST localhost:8100/predict -d '{"instances": [[[..]]]}'
+    python -m ddp_tpu.obs serve_spill.jsonl             # telemetry
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Optional
+
+from ..utils.platform import pin_platform_from_env
+
+pin_platform_from_env()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.serve",
+        description=__doc__.splitlines()[0])
+    p.add_argument("--snapshot_path", default="checkpoint.pt",
+                   help="Checkpoint head path or directory (the trainer's "
+                        "--snapshot_path); the newest VERIFIABLE snapshot "
+                        "is loaded via resilience.lineage (default: "
+                        "checkpoint.pt)")
+    p.add_argument("--model", default="vgg",
+                   choices=["vgg", "deepnn", "resnet18"],
+                   help="Model architecture the checkpoint was trained "
+                        "with (default: vgg — the reference's model)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="Bind address (default 127.0.0.1; 0.0.0.0 to "
+                        "expose)")
+    p.add_argument("--port", default=8100, type=int,
+                   help="Listen port (default 8100; 0 picks a free port "
+                        "and prints it)")
+    p.add_argument("--buckets", default="1,8,32,128",
+                   help="Padded batch buckets, comma-separated; each is "
+                        "rounded up to a mesh-size multiple and compiled "
+                        "ONCE at startup — the whole executable set, "
+                        "bounded and known (default 1,8,32,128)")
+    p.add_argument("--max_batch", default=None, type=int,
+                   help="Batch-former row target (default: the largest "
+                        "bucket)")
+    p.add_argument("--max_wait_ms", default=5.0, type=float,
+                   help="Batch-forming wait budget from the oldest queued "
+                        "request (default 5 ms): a lone request never "
+                        "waits longer; a busy queue never waits at all")
+    p.add_argument("--queue_depth", default=256, type=int,
+                   help="Admission queue bound; a full queue sheds with "
+                        "503 instead of queueing into unbounded latency "
+                        "(default 256 requests)")
+    p.add_argument("--bf16", action="store_true",
+                   help="Serve in bfloat16 compute (match the flag the "
+                        "checkpoint was trained with for parity)")
+    p.add_argument("--num_devices", default=None, type=int,
+                   help="Mesh size override (default: all visible "
+                        "devices); formed batches shard across the same "
+                        "data axis training uses")
+    p.add_argument("--trace_spill", default="serve_spill.jsonl",
+                   metavar="PATH",
+                   help="Span spill (queue_wait/batch_form/pad/h2d/"
+                        "forward/d2h), analyzable with python -m "
+                        "ddp_tpu.obs exactly like a training spill; '' "
+                        "keeps tracing in-memory only (default "
+                        "serve_spill.jsonl)")
+    p.add_argument("--obs_off", action="store_true",
+                   help="Telemetry kill-switch (the training CLI's "
+                        "contract: no spans, no spill, zero overhead)")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    from ..obs.tracer import NullTracer, SpanTracer, set_tracer
+    from ..parallel.mesh import make_mesh
+    from ..resilience.preemption import PreemptionGuard
+    from .batcher import DynamicBatcher
+    from .engine import ServeEngine
+    from .http import ServeHTTPServer
+
+    if args.obs_off:
+        tracer = NullTracer()
+    else:
+        tracer = SpanTracer(spill_path=args.trace_spill or None,
+                            ring=65536, host=0)
+    mesh = make_mesh(args.num_devices)
+    buckets = [int(b) for b in args.buckets.split(",") if b]
+    try:
+        set_tracer(tracer)
+        print(f"loading newest verifiable checkpoint under "
+              f"{args.snapshot_path!r} ...", file=sys.stderr)
+        engine = ServeEngine.from_checkpoint(
+            args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
+            compute_dtype=jnp.bfloat16 if args.bf16 else None,
+            tracer=tracer)
+        t0 = time.monotonic()
+        compiled = engine.warm()
+        print(f"compiled {compiled} bucket executable(s) "
+              f"{list(engine.buckets)} in {time.monotonic() - t0:.1f}s "
+              f"(checkpoint {engine.checkpoint_file!r}, epoch "
+              f"{engine.checkpoint_epoch}); no request pays a compile",
+              file=sys.stderr)
+        batcher = DynamicBatcher(engine, max_batch=args.max_batch,
+                                 max_wait_ms=args.max_wait_ms,
+                                 queue_depth=args.queue_depth,
+                                 tracer=tracer).start()
+        httpd = ServeHTTPServer((args.host, args.port), engine, batcher)
+        listener = threading.Thread(target=httpd.serve_forever,
+                                    daemon=True, name="serve-http")
+        listener.start()
+        # Graceful drain on SIGTERM/SIGINT — the same resilience guard
+        # the trainer uses for preemption (main-thread only; under a
+        # non-main-thread embedder, stop via batcher.drain()+shutdown()).
+        guard = (PreemptionGuard().install()
+                 if threading.current_thread() is threading.main_thread()
+                 else None)
+        host, port = httpd.server_address[:2]
+        print(f"serving {args.model} on http://{host}:{port} "
+              "(/predict /healthz /stats); SIGTERM drains gracefully",
+              flush=True)
+        try:
+            while guard is None or not guard.noticed():
+                time.sleep(0.2)
+        except KeyboardInterrupt:
+            pass  # second Ctrl-C during shutdown lands here; drain anyway
+        print("draining: admission stopped, serving accepted requests ...",
+              file=sys.stderr)
+        drained = batcher.drain(timeout=30.0)
+        httpd.shutdown()
+        httpd.server_close()
+        if guard is not None:
+            guard.uninstall()
+        stats = {"engine": engine.stats(), "batcher": batcher.stats()}
+        print(json.dumps(stats), file=sys.stderr)
+        print(f"drained={'clean' if drained else 'FORCED'}; bye",
+              file=sys.stderr)
+        return 0 if drained else 1
+    finally:
+        set_tracer(NullTracer())
+        tracer.flush(fsync=True)
+        tracer.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,237 @@
+"""The serving forward engine: bucketed, AOT-warmed, mesh-sharded.
+
+Mesh-TensorFlow's discipline for production TPU inference (Shazeer et
+al., PAPERS.md arxiv 1811.02084) is a SMALL, FIXED set of padded-shape
+compiled programs — every request executes one of them, none ever waits
+on a compile.  This engine is that discipline around the training
+framework's own eval forward: the per-shard apply is
+:func:`~ddp_tpu.train.step.make_eval_apply`, the exact function
+``evaluate()``'s counters trace, so served logits cannot drift from the
+training-loop evaluation of the same checkpoint (tests/test_serve.py
+pins bit-identity at matched bucket shapes).
+
+Shape policy: requests are padded up to the smallest *bucket* (each
+bucket rounded up to a mesh-size multiple so the ``data``-axis shard_map
+sees equal shards), the bucket set is fixed at construction, and every
+bucket's executable is compiled at startup (``warm()``).  A request
+larger than the largest bucket is refused with :class:`RequestTooLarge`
+— the caller-visible alternative to an unbounded-compile surprise.
+The engine COUNTS traces (``trace_count`` — a Python side effect inside
+the traced function, so it increments exactly once per compiled
+executable and never on a cache hit): the compile-bound contract is an
+assertable number, not a comment.
+
+Telemetry: every forward records ``pad`` / ``h2d`` / ``forward`` /
+``d2h`` spans (obs/tracer.py) keyed by a running batch sequence number,
+so ``python -m ddp_tpu.obs`` and the Perfetto export explain serve runs
+exactly as they do training runs.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.tracer import get_tracer
+from ..parallel.mesh import batch_sharding, replicated_sharding
+from ..train.step import make_eval_forward
+
+
+class ServeError(Exception):
+    """Base class for request-visible serving failures."""
+
+
+class RequestTooLarge(ServeError):
+    """More rows than the largest padded batch bucket — the engine will
+    never compile an ad-hoc shape for it; split the request instead."""
+
+
+def resolve_buckets(buckets: Sequence[int], mesh_size: int) -> Tuple[int, ...]:
+    """The effective padded-batch bucket set: each requested bucket
+    rounded UP to a mesh-size multiple (the ``data``-axis shard_map needs
+    equal per-device shards), deduplicated, ascending.  Rounding two
+    requested buckets onto one shape (e.g. 1 and 8 on an 8-device mesh)
+    is normal — the compile-bound contract is on the RESOLVED set."""
+    if not buckets:
+        raise ValueError("need at least one batch bucket")
+    if any(b < 1 for b in buckets):
+        raise ValueError(f"batch buckets must be >= 1, got {list(buckets)}")
+    return tuple(sorted({-(-int(b) // mesh_size) * mesh_size
+                         for b in buckets}))
+
+
+class ServeEngine:
+    """Eval-mode forwards over the training mesh, one executable per bucket.
+
+    ``forward()`` is synchronous and single-caller by design (the dynamic
+    batcher's engine thread is the one caller in the serving stack); it
+    is still guarded by a lock so misuse degrades to serialization, not
+    interleaved telemetry.
+    """
+
+    # CIFAR sample shape — the one input every model in the registry takes.
+    input_shape = (32, 32, 3)
+
+    def __init__(self, model, params, batch_stats, mesh, *,
+                 buckets: Sequence[int] = (1, 8, 32, 128),
+                 compute_dtype=None, tracer=None):
+        self.model = model
+        self.mesh = mesh
+        self.compute_dtype = compute_dtype
+        self.buckets = resolve_buckets(buckets, mesh.devices.size)
+        self.max_rows = self.buckets[-1]
+        self.trace_count = 0
+
+        def _on_trace() -> None:
+            self.trace_count += 1
+
+        self._fwd = make_eval_forward(model, mesh, compute_dtype,
+                                      on_trace=_on_trace)
+        rep = replicated_sharding(mesh)
+        as_dev = lambda t: jax.device_put(  # noqa: E731
+            jax.tree_util.tree_map(jnp.asarray, t), rep)
+        self._params = as_dev(params)
+        self._stats = as_dev(batch_stats)
+        self._sharding = batch_sharding(mesh)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._lock = threading.Lock()  # the pipeline (one forward at a time)
+        # Counters get their OWN lock: /healthz and /stats read them and
+        # must not block behind an in-flight forward (hundreds of ms at
+        # load — a health probe that flaps under load is worse than none).
+        self._stats_lock = threading.Lock()
+        self._seq = 0  # forward-batch sequence number (span step key)
+        self._per_bucket: Dict[int, int] = {b: 0 for b in self.buckets}
+        self.rows_served = 0
+        self.warmed = False
+        # Provenance (set by from_checkpoint): which snapshot this engine
+        # answers for — surfaced on /healthz so "what model is live" is
+        # one curl, not an ops archaeology session.
+        self.checkpoint_file: Optional[str] = None
+        self.checkpoint_epoch: Optional[int] = None
+        self.checkpoint_step: Optional[int] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, snapshot_path: str, model_name: str, *, mesh,
+                        buckets: Sequence[int] = (1, 8, 32, 128),
+                        compute_dtype=None, tracer=None) -> "ServeEngine":
+        """Load the newest *verifiable* checkpoint under ``snapshot_path``
+        (a head path or a directory) through the SAME lineage walk the
+        trainer's ``--resume`` uses — ``resilience.lineage
+        .latest_verifiable`` — so a torn head falls back to the newest
+        retained snapshot instead of serving nothing."""
+        from ..models import get_model
+        from ..resilience.lineage import latest_verifiable
+        from ..train.checkpoint import CheckpointError
+        loaded = latest_verifiable(snapshot_path)
+        if loaded is None:
+            raise CheckpointError(
+                f"no checkpoint found under {snapshot_path!r}; the serve "
+                "engine needs a trained snapshot (run training with "
+                "--snapshot_path first)")
+        ckpt, used = loaded
+        engine = cls(get_model(model_name), ckpt.params, ckpt.batch_stats,
+                     mesh, buckets=buckets, compute_dtype=compute_dtype,
+                     tracer=tracer)
+        engine.checkpoint_file = used
+        engine.checkpoint_epoch = int(ckpt.epoch)
+        engine.checkpoint_step = int(ckpt.step)
+        return engine
+
+    def warm(self) -> int:
+        """Compile every bucket's executable NOW (startup), so no request
+        ever pays a compile.  Returns the number of compiled executables
+        (== the resolved bucket-set size; ``trace_count`` proves it)."""
+        for b in self.buckets:
+            zeros = np.zeros((b,) + self.input_shape, np.uint8)
+            jax.block_until_ready(self._fwd(
+                self._params, self._stats,
+                jax.device_put(zeros, self._sharding)))
+        self.warmed = True
+        return self.trace_count
+
+    # -- serving -----------------------------------------------------------
+
+    def bucket_for(self, n_rows: int) -> int:
+        """Smallest bucket holding ``n_rows``; :class:`RequestTooLarge`
+        beyond the largest (shedding belongs at ADMISSION, not after the
+        work is half done)."""
+        for b in self.buckets:
+            if n_rows <= b:
+                return b
+        raise RequestTooLarge(
+            f"{n_rows} rows exceed the largest padded batch bucket "
+            f"{self.max_rows}; split the request or restart the server "
+            "with a larger --buckets set")
+
+    def forward(self, images: np.ndarray) -> np.ndarray:
+        """Logits for ``images`` (uint8 ``[n, 32, 32, 3]`` — the loaders'
+        wire format; one dtype keeps the executable set at one program
+        per bucket).  Pads to the bucket, runs the compiled forward,
+        returns the valid ``[n, num_classes]`` float32 rows."""
+        images = np.asarray(images)
+        if images.ndim != 4 or images.shape[1:] != self.input_shape:
+            raise ValueError(
+                f"expected images [n, {', '.join(map(str, self.input_shape))}"
+                f"], got {images.shape}")
+        if images.dtype != np.uint8:
+            raise ValueError(
+                f"expected uint8 images (the loaders' wire format), got "
+                f"{images.dtype}; scale/quantize on the client")
+        n = images.shape[0]
+        if n == 0:
+            return np.zeros((0, 0), np.float32)
+        bucket = self.bucket_for(n)
+        with self._lock:
+            with self._stats_lock:
+                seq = self._seq
+                self._seq += 1
+            tracer = self.tracer
+            with tracer.span("pad", step=seq):
+                if n < bucket:
+                    padded = np.zeros((bucket,) + self.input_shape, np.uint8)
+                    padded[:n] = images
+                else:
+                    padded = images
+            with tracer.span("h2d", step=seq):
+                dev = jax.device_put(padded, self._sharding)
+            with tracer.span("forward", step=seq):
+                out = self._fwd(self._params, self._stats, dev)
+                out.block_until_ready()
+            with tracer.span("d2h", step=seq):
+                logits = np.asarray(jax.device_get(out))[:n]
+            with self._stats_lock:
+                self._per_bucket[bucket] += 1
+                self.rows_served += n
+        return logits
+
+    def predict(self, images: np.ndarray) -> np.ndarray:
+        """Argmax class ids — the ``/predict`` convenience over
+        :meth:`forward`."""
+        return np.argmax(self.forward(images), axis=-1).astype(np.int64)
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:  # never the pipeline lock: see __init__
+            return {
+                "buckets": list(self.buckets),
+                "compiled_executables": self.trace_count,
+                "forward_batches": self._seq,
+                "forward_batches_per_bucket": {
+                    str(b): c for b, c in self._per_bucket.items()},
+                "rows_served": self.rows_served,
+                "mesh_devices": int(self.mesh.devices.size),
+                "compute_dtype": (str(np.dtype(self.compute_dtype).name)
+                                  if self.compute_dtype is not None
+                                  else "float32"),
+                "checkpoint": {
+                    "file": self.checkpoint_file,
+                    "epoch": self.checkpoint_epoch,
+                    "step": self.checkpoint_step,
+                },
+            }

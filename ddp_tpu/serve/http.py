@@ -1,0 +1,156 @@
+"""Stdlib-only threaded HTTP front end for the serving stack.
+
+One ``ThreadingHTTPServer`` (a thread per connection — the blocking
+``submit()`` call parks the handler thread while the engine thread does
+the work, which is exactly the dynamic batcher's concurrency model):
+
+- ``POST /predict``  body ``{"instances": [[...32x32x3 uint8...], ...]}``
+  (one image's nested list is accepted bare) -> ``{"predictions": [...],
+  "logits": [[...]]}``.  Admission failures map to transport-visible
+  status codes: 400 malformed, 413 oversized (larger than the biggest
+  bucket), 503 shed/draining with ``Retry-After`` — backpressure the
+  client can act on, never an unbounded queue.
+- ``GET /healthz``   liveness + which checkpoint is live; flips to
+  ``"draining"`` (503) during graceful shutdown so load balancers stop
+  routing before the listener closes.
+- ``GET /stats``     engine + batcher counters (bucket usage, latency
+  percentiles, shed counts, compiled-executable count).
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import Draining, DynamicBatcher, QueueFull
+from .engine import RequestTooLarge, ServeEngine
+
+# Practical request-body bound: the largest sane request is
+# max_rows * 32*32*3 bytes of pixels, JSON-inflated ~4x; 64 MiB covers a
+# 1024-row bucket with headroom while refusing a memory-bomb POST early.
+MAX_BODY_BYTES = 64 << 20
+
+# Per-request completion bound: submit() must NOT wait forever (a lost
+# completion would park the handler thread and the client indefinitely —
+# the exact unbounded latency the 503/504 design exists to prevent).
+# Generous: covers a full queue of max-bucket forwards on a slow box.
+REQUEST_TIMEOUT_S = 60.0
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    """The listener; carries the serving stack for handler access."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, engine: ServeEngine, batcher: DynamicBatcher,
+                 quiet: bool = True):
+        self.engine = engine
+        self.batcher = batcher
+        self.quiet = quiet
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: ServeHTTPServer
+
+    # Socket timeout: a client that sends headers and then stalls the
+    # body (slowloris) must not park a handler thread forever in
+    # rfile.read() — the stdlib handler catches the resulting timeout
+    # and closes the connection, reclaiming the thread.
+    timeout = 60
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # noqa: D102 — stdlib signature
+        if not self.server.quiet:
+            super().log_message(fmt, *args)
+
+    def _reply(self, status: int, payload: dict,
+               retry_after: Optional[int] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client gave up; its latency bound, its call
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib naming
+        if self.path == "/healthz":
+            draining = self.server.batcher.draining
+            self._reply(503 if draining else 200, {
+                "status": "draining" if draining else "ok",
+                "buckets": list(self.server.engine.buckets),
+                "compiled_executables": self.server.engine.trace_count,
+                "checkpoint": self.server.engine.stats()["checkpoint"],
+            })
+        elif self.path == "/stats":
+            self._reply(200, {"engine": self.server.engine.stats(),
+                              "batcher": self.server.batcher.stats()})
+        else:
+            self._reply(404, {"error": f"no route {self.path!r}; try "
+                                       "/predict, /healthz, /stats"})
+
+    # -- POST /predict -----------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802 — stdlib naming
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no route {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length <= 0 or length > MAX_BODY_BYTES:
+            self._reply(400, {"error": f"Content-Length must be in "
+                                       f"(0, {MAX_BODY_BYTES}]"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length))
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._reply(400, {"error": f"body is not valid JSON: {e}"})
+            return
+        instances = (payload.get("instances")
+                     if isinstance(payload, dict) else payload)
+        try:
+            images = np.asarray(instances)
+            if images.ndim == 3:  # one bare image
+                images = images[None]
+            if not np.issubdtype(images.dtype, np.integer) or \
+                    images.min() < 0 or images.max() > 255:
+                raise ValueError(
+                    "pixel values must be integers in [0, 255] (uint8 — "
+                    "the training loaders' wire format)")
+            images = images.astype(np.uint8)
+            logits = self.server.batcher.submit(
+                images, timeout=REQUEST_TIMEOUT_S)
+        except RequestTooLarge as e:
+            self._reply(413, {"error": str(e)})
+            return
+        except (QueueFull, Draining) as e:
+            self._reply(503, {"error": str(e)}, retry_after=1)
+            return
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:
+            # An engine/runtime failure (XLA error mid-forward) reaches
+            # every co-batched caller via req.error — answer it as a
+            # 5xx the client can log and retry on, never a reset socket.
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {
+            "predictions": np.argmax(logits, axis=-1).astype(int).tolist(),
+            "logits": [[float(v) for v in row] for row in logits],
+        })

@@ -1,12 +1,14 @@
 from .checkpoint import CheckpointError, load_checkpoint, save_checkpoint
 from .epoch import make_eval_epoch, make_train_epoch, put_index_matrix
 from .evaluate import evaluate
-from .step import TrainState, make_eval_step, make_train_step, shard_batch
+from .step import (TrainState, make_eval_apply, make_eval_forward,
+                   make_eval_step, make_train_step, shard_batch)
 from .trainer import Trainer
 
 __all__ = [
     "CheckpointError", "TrainState", "Trainer", "evaluate",
     "load_checkpoint",
-    "make_eval_epoch", "make_eval_step", "make_train_epoch",
+    "make_eval_apply", "make_eval_epoch", "make_eval_forward",
+    "make_eval_step", "make_train_epoch",
     "make_train_step", "put_index_matrix", "save_checkpoint", "shard_batch",
 ]

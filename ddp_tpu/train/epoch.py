@@ -35,9 +35,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..parallel.mesh import DATA_AXIS, replicated_sharding, scan_unroll
-from .step import (TrainState, _as_input, make_accum_scan, make_group_step,
-                   make_group_update, make_loss_and_grads, make_single_micro,
-                   micro_from_table)
+from .step import (TrainState, make_accum_scan, make_eval_apply,
+                   make_group_step, make_group_update, make_loss_and_grads,
+                   make_single_micro, micro_from_table)
 
 
 def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
@@ -145,15 +145,15 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
     padding rows out of both counters).
     """
 
+    apply_fn = make_eval_apply(model, compute_dtype)
+
     def _shard_body(params, batch_stats, images, labels, idx, mask):
         from ..ops.gather import gather_rows
 
         def one_step(carry, xs):
             idx_row, mask_row = xs
-            logits, _ = model.apply(params, batch_stats,
-                                    _as_input(gather_rows(images, idx_row),
-                                              compute_dtype),
-                                    train=False, compute_dtype=compute_dtype)
+            logits = apply_fn(params, batch_stats,
+                              gather_rows(images, idx_row))
             pred = jnp.argmax(logits, axis=-1)
             hit = (pred == labels[idx_row]).astype(jnp.float32)
             c, t = carry

@@ -5,6 +5,13 @@ Differences, both sanctioned by SURVEY.md (appendix): the test set is
 *sharded* over the mesh with ``psum``-ed correct/total counters instead of
 every rank redundantly scoring the whole set, and BN uses the replicated
 running stats (``model.eval()`` semantics, singlegpu.py:189).
+
+The eval-mode forward itself lives in ONE place —
+:func:`~ddp_tpu.train.step.make_eval_apply` — traced by the counter
+program here (via ``make_eval_step``), by the resident eval scan
+(train/epoch.py), and by the serving engine's logits program
+(ddp_tpu/serve/engine.py), so served predictions cannot drift from this
+function's accuracy on the same checkpoint (tests/test_serve.py).
 """
 from __future__ import annotations
 

@@ -328,6 +328,63 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
     return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
 
 
+def make_eval_apply(model, compute_dtype=None):
+    """The per-shard eval-mode forward — ``fn(params, batch_stats, images)
+    -> logits`` with BN in running-stats mode (``model.eval()`` semantics,
+    singlegpu.py:189) and the on-device uint8 ToTensor scaling.
+
+    This is the ONE eval forward in the codebase: :func:`make_eval_step`
+    (training-loop evaluation) and :func:`make_eval_forward` (the serving
+    engine's logits program, ddp_tpu/serve/) both trace exactly this
+    function, so served predictions cannot drift from ``evaluate()``.
+    """
+
+    def apply_fn(params, batch_stats, images):
+        logits, _ = model.apply(params, batch_stats,
+                                _as_input(images, compute_dtype),
+                                train=False, compute_dtype=compute_dtype)
+        return logits
+
+    return apply_fn
+
+
+def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
+                      on_trace: Callable[[], None] = None):
+    """Jitted sharded eval forward returning the LOGITS themselves:
+    ``forward(params, batch_stats, images[B,H,W,C]) -> logits[B,C]`` with
+    the batch sharded on ``data`` and per-row results gathered — the
+    program the serving engine (ddp_tpu/serve/engine.py) compiles per
+    padded batch bucket, and the test surface for logit-level parity with
+    :func:`make_eval_step` (both trace :func:`make_eval_apply`).
+
+    ``on_trace`` (optional) is called at TRACE time — i.e. exactly once
+    per compiled executable, never on a cache hit — which is how the
+    serve engine *proves* its compiled-program count stays bounded at the
+    bucket-set size (tests/test_serve.py).
+
+    Numerics note: per-row logits are independent of the other rows in
+    eval mode (BN uses running stats), and on this CPU backend they are
+    bit-identical across mesh sizes at matched per-shard row counts; XLA
+    may still pick a differently-rounded kernel strategy for a much
+    larger per-shard batch shape, so bit-for-bit comparisons must compare
+    matching bucket shapes (the contract tests/test_serve.py pins).
+    """
+    apply_fn = make_eval_apply(model, compute_dtype)
+
+    def _shard_body(params, batch_stats, images):
+        if on_trace is not None:
+            on_trace()  # Python side effect: runs only while tracing
+        return apply_fn(params, batch_stats, images)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(P(), P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+    return jax.jit(mapped,
+                   out_shardings=NamedSharding(mesh, P(DATA_AXIS)))
+
+
 def make_eval_step(model, mesh: Mesh, compute_dtype=None):
     """Sharded evaluation step: global (correct, total) via ``psum``.
 
@@ -335,13 +392,13 @@ def make_eval_step(model, mesh: Mesh, compute_dtype=None):
     (multigpu.py:247, SURVEY.md §3.5); here each shard scores its slice and
     the counters are summed over ICI — same result, 1/N the work.  ``mask``
     zeroes the padding rows that keep shapes static (test set size need not
-    divide the mesh).
+    divide the mesh).  The forward is :func:`make_eval_apply` — the same
+    function the serving engine's logits program traces.
     """
+    apply_fn = make_eval_apply(model, compute_dtype)
 
     def _shard_body(params, batch_stats, batch):
-        logits, _ = model.apply(params, batch_stats,
-                                _as_input(batch["image"], compute_dtype),
-                                train=False, compute_dtype=compute_dtype)
+        logits = apply_fn(params, batch_stats, batch["image"])
         pred = jnp.argmax(logits, axis=-1)
         maskf = batch["mask"].astype(jnp.float32)
         correct = ((pred == batch["label"]).astype(jnp.float32) * maskf).sum()

@@ -121,7 +121,7 @@ class Trainer:
         # lazily (package-cycle hygiene, same as the zero/resident paths).
         from ..resilience.guard import StepHealthGuard
         from ..resilience.lineage import (CheckpointLineage,
-                                          load_latest_verifiable)
+                                          latest_verifiable)
         self.lineage = (CheckpointLineage(snapshot_path,
                                           keep=keep_checkpoints)
                         if snapshot_path else None)
@@ -134,7 +134,7 @@ class Trainer:
             # Lineage-aware restore: the head first, then each retained
             # snapshot — a torn head is a recoverable, logged event, not a
             # fatal one (fatal only when EVERY candidate is torn).
-            loaded = load_latest_verifiable(snapshot_path)
+            loaded = latest_verifiable(snapshot_path)
             if loaded is not None:
                 ckpt, used = loaded
                 self.state = TrainState(
@@ -500,10 +500,10 @@ class Trainer:
         non-finite verdict came from replicated losses), so multi-host
         stays in lockstep."""
         from ..resilience.guard import NonFiniteLossError
-        from ..resilience.lineage import load_latest_verifiable
+        from ..resilience.lineage import latest_verifiable
         self._join_pending_save()  # let any in-flight (good) write land
         self._pending_losses = None  # the poisoned trajectory's records
-        loaded = (load_latest_verifiable(self.snapshot_path)
+        loaded = (latest_verifiable(self.snapshot_path)
                   if self.snapshot_path else None)
         if loaded is None:
             raise NonFiniteLossError(

@@ -1,0 +1,518 @@
+"""Serving subsystem (ddp_tpu/serve/) — ISSUE 4.
+
+Four contracts:
+- PARITY: served logits are bit-identical to the training-side eval
+  forward at matched bucket shapes (both trace make_eval_apply — the one
+  eval forward), served predictions reproduce evaluate()'s accuracy, and
+  an 8-device training checkpoint restores into a 1-device serve engine
+  with bit-identical logits (checkpoint portability).
+- BOUNDED COMPILES: the executable set is exactly the resolved bucket
+  set, regardless of the request-size mix (trace_count proves it).
+- ADMISSION CONTROL: oversized requests rejected at admission, full
+  queue sheds explicitly, empty queue idles, drain serves accepted work
+  before exit.
+- TELEMETRY: serve spans spill/export through the unchanged obs tooling.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from ddp_tpu.data import EvalLoader, synthetic
+from ddp_tpu.models import get_model
+from ddp_tpu.parallel import make_mesh
+from ddp_tpu.serve import (Draining, DynamicBatcher, QueueFull,
+                           RequestTooLarge, ServeEngine, ServeHTTPServer,
+                           resolve_buckets)
+from ddp_tpu.train import evaluate, make_eval_forward
+
+
+@pytest.fixture(scope="module")
+def deepnn():
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    return model, params, stats
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def engine8(deepnn, mesh8):
+    model, params, stats = deepnn
+    eng = ServeEngine(model, params, stats, mesh8, buckets=(1, 8, 32))
+    eng.warm()
+    return eng
+
+
+def _images(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, 32, 32, 3)).astype(np.uint8)
+
+
+# -- bucket resolution -----------------------------------------------------
+
+def test_bucket_resolution_rounds_to_mesh_multiples(mesh8):
+    # 1 and 8 both round to one 8-row shape on an 8-device mesh: the
+    # compile-bound contract counts RESOLVED buckets.
+    assert resolve_buckets((1, 8, 32, 128), 8) == (8, 32, 128)
+    assert resolve_buckets((1, 8, 32, 128), 1) == (1, 8, 32, 128)
+    assert resolve_buckets((5,), 4) == (8,)
+    with pytest.raises(ValueError):
+        resolve_buckets((), 8)
+    with pytest.raises(ValueError):
+        resolve_buckets((0,), 8)
+
+
+# -- logits parity ---------------------------------------------------------
+
+def test_served_logits_bit_identical_to_eval_forward(engine8, deepnn,
+                                                     mesh8):
+    """At a matched bucket shape, the engine's logits are byte-for-byte
+    the shared eval forward's (a freshly-built jit of the same program —
+    same traced function, same mesh, same shape, same bytes)."""
+    model, params, stats = deepnn
+    imgs = _images(32)
+    fwd = make_eval_forward(model, mesh8)
+    ref = np.asarray(jax.device_get(fwd(params, stats, imgs)))
+    np.testing.assert_array_equal(engine8.forward(imgs), ref)
+
+
+def test_served_accuracy_matches_evaluate(engine8, deepnn, mesh8):
+    """Served predictions reproduce evaluate()'s accuracy on the same
+    checkpoint state — the golden-accuracy guard for the eval-forward
+    dedup (the satellite's 'evaluate() still produces its golden
+    accuracy' is pinned end-to-end by tests/test_acceptance.py; this
+    pins serve against evaluate on the same weights)."""
+    model, params, stats = deepnn
+    _, test_ds = synthetic(n_train=64, n_test=96, seed=3)
+    loader = EvalLoader(test_ds, 4, 8)  # global batch 32 == a bucket
+    acc_eval = evaluate(model, params, stats, loader, mesh8,
+                        progress=False)
+    correct = total = 0
+    for start in range(0, len(test_ds), 32):
+        imgs = test_ds.images[start:start + 32]
+        labels = test_ds.labels[start:start + 32]
+        pred = engine8.forward(imgs).argmax(-1)
+        correct += int((pred == labels).sum())
+        total += len(labels)
+    acc_serve = correct / total * 100.0
+    assert acc_serve == pytest.approx(acc_eval, abs=1e-9)
+
+
+def test_padding_rows_do_not_leak_into_results(engine8):
+    """A 5-row request (padded to the 8-bucket) returns logits that agree
+    with the same rows served in a full 32-bucket batch: per-row results
+    are independent of batch composition (eval-mode BN uses running
+    stats).  Bit-identity is only guaranteed at MATCHED shapes (XLA may
+    round differently per program — ddp_tpu/train/step.py numerics
+    note), so cross-bucket comparison is allclose + identical argmax."""
+    imgs = _images(32, seed=1)
+    full = engine8.forward(imgs)
+    small = engine8.forward(imgs[:5])
+    np.testing.assert_allclose(small, full[:5], rtol=0, atol=1e-6)
+    np.testing.assert_array_equal(small.argmax(-1), full[:5].argmax(-1))
+    # Same request shape twice -> same program -> same bytes.
+    np.testing.assert_array_equal(small, engine8.forward(imgs[:5]))
+
+
+# -- checkpoint portability ------------------------------------------------
+
+def test_checkpoint_from_8dev_training_serves_on_1dev(tmp_path, mesh8):
+    """A snapshot written by a TRAINING RUN on the 8-device virtual mesh
+    restores into a 1-device serve engine, and the served logits match
+    the 8-device eval forward of the restored state bit-for-bit (per-
+    shard row counts 4 vs 32 — matched-rounding territory on this
+    backend)."""
+    from ddp_tpu.data import TrainLoader
+    from ddp_tpu.optim import SGDConfig, triangular_lr
+    from ddp_tpu.train import Trainer
+    import functools
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(1))
+    train_ds, _ = synthetic(n_train=64, seed=2)
+    loader = TrainLoader(train_ds, 8, 8, augment=True, seed=0)
+    path = str(tmp_path / "ck.pt")
+    trainer = Trainer(
+        model, loader, params, stats, mesh=mesh8,
+        lr_schedule=functools.partial(triangular_lr, base_lr=0.05,
+                                      num_epochs=1, steps_per_epoch=1),
+        sgd_config=SGDConfig(lr=0.05), save_every=1, snapshot_path=path,
+        keep_checkpoints=2)
+    trainer.train(1)
+
+    engine = ServeEngine.from_checkpoint(path, "deepnn",
+                                         mesh=make_mesh(1), buckets=(32,))
+    assert engine.warm() == 1
+    assert engine.checkpoint_file == path
+    assert engine.checkpoint_epoch == 0
+
+    from ddp_tpu.resilience.lineage import latest_verifiable
+    ckpt, used = latest_verifiable(path)
+    fwd = make_eval_forward(model, mesh8)
+    imgs = _images(32, seed=4)
+    ref = np.asarray(jax.device_get(fwd(
+        jax.tree_util.tree_map(np.asarray, ckpt.params),
+        jax.tree_util.tree_map(np.asarray, ckpt.batch_stats), imgs)))
+    np.testing.assert_array_equal(engine.forward(imgs), ref)
+
+
+def test_latest_verifiable_accepts_a_directory(tmp_path, deepnn):
+    """The serve engine is pointed at 'where checkpoints land' — a
+    directory resolves to the manifest's head (or the default
+    checkpoint.pt), through the same lineage walk --resume uses."""
+    from ddp_tpu.optim import SGDState  # noqa: F401  (import guard only)
+    from ddp_tpu.resilience.lineage import (CheckpointLineage,
+                                            latest_verifiable)
+    from ddp_tpu.train import save_checkpoint
+    from ddp_tpu.train.step import init_train_state
+    model, params, stats = deepnn
+    state = init_train_state(params, stats)
+    path = str(tmp_path / "checkpoint.pt")
+    sha = save_checkpoint(path, state.params, state.batch_stats,
+                          state.opt_state, step=5, epoch=2)
+    CheckpointLineage(path, keep=1).commit(epoch=2, step=5, sha256=sha)
+    ckpt, used = latest_verifiable(str(tmp_path))
+    assert used == path and ckpt.epoch == 2 and ckpt.step == 5
+    # And with several manifests the resolution refuses to guess.
+    path2 = str(tmp_path / "other.pt")
+    sha2 = save_checkpoint(path2, state.params, state.batch_stats,
+                           state.opt_state, step=1, epoch=0)
+    CheckpointLineage(path2, keep=1).commit(epoch=0, step=1, sha256=sha2)
+    from ddp_tpu.train import CheckpointError
+    with pytest.raises(CheckpointError, match="manifests"):
+        latest_verifiable(str(tmp_path))
+
+
+# -- bounded compiles ------------------------------------------------------
+
+def test_compile_count_bounded_at_bucket_set_size(engine8):
+    """Any request-size mix executes the startup bucket set — zero new
+    traces (trace_count is a Python side effect inside the traced
+    function: it increments once per XLA compile, never on a hit)."""
+    warm_traces = engine8.trace_count
+    assert warm_traces == len(engine8.buckets)
+    batcher = DynamicBatcher(engine8, max_wait_ms=1.0).start()
+    try:
+        for n in (1, 2, 3, 5, 7, 8, 9, 13, 17, 25, 31, 32):
+            batcher.submit(_images(n, seed=n), timeout=30)
+    finally:
+        batcher.drain(timeout=10)
+    assert engine8.trace_count == warm_traces
+    assert engine8.stats()["compiled_executables"] == len(engine8.buckets)
+
+
+# -- batcher admission / edge cases ---------------------------------------
+
+class _StubEngine:
+    """Engine-shaped double for batcher edge cases: no XLA, controllable
+    forward latency, engine-identical admission surface."""
+    input_shape = (32, 32, 3)
+
+    def __init__(self, max_rows=32, delay_s=0.0):
+        self.buckets = (8, max_rows)
+        self.max_rows = max_rows
+        self.delay_s = delay_s
+        self._seq = 0
+        self.trace_count = len(self.buckets)
+        self.calls = []
+
+    def stats(self):
+        return {"buckets": list(self.buckets),
+                "compiled_executables": self.trace_count,
+                "checkpoint": {"file": None, "epoch": None, "step": None}}
+
+    def forward(self, images):
+        self._seq += 1
+        self.calls.append(images.shape[0])
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        n = images.shape[0]
+        return np.repeat(np.arange(n, dtype=np.float32)[:, None], 10, 1) \
+            + images.reshape(n, -1)[:, :1].astype(np.float32)
+
+
+def test_empty_queue_timeout_is_not_an_event():
+    """An idle batcher (nothing queued past the wait budget) just keeps
+    polling: no error, no busy spin, and the next request is served
+    normally."""
+    b = DynamicBatcher(_StubEngine(), max_wait_ms=1.0).start()
+    try:
+        time.sleep(0.3)  # several empty poll cycles
+        out = b.submit(_images(2), timeout=5)
+        assert out.shape == (2, 10)
+        assert b.stats()["served_requests"] == 1
+    finally:
+        b.drain(timeout=5)
+
+
+def test_oversized_request_rejected_with_clear_error():
+    b = DynamicBatcher(_StubEngine(max_rows=16)).start()
+    try:
+        with pytest.raises(RequestTooLarge, match="largest padded batch"):
+            b.submit(_images(17))
+        assert b.stats()["rejected_oversize"] == 1
+        assert b.stats()["served_requests"] == 0
+    finally:
+        b.drain(timeout=5)
+
+
+def test_queue_full_sheds_with_backpressure_error():
+    """With a slow engine and a 2-deep queue, concurrent submitters past
+    the bound get QueueFull immediately (shed at admission), and every
+    ACCEPTED request is still served correctly."""
+    eng = _StubEngine(delay_s=0.05)
+    b = DynamicBatcher(eng, max_batch=1, max_wait_ms=0.0, queue_depth=2)
+    b.start()
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(i):
+        try:
+            b.submit(_images(1, seed=i), timeout=30)
+            with lock:
+                outcomes.append("served")
+        except QueueFull:
+            with lock:
+                outcomes.append("shed")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(12)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outcomes.count("shed") >= 1
+        assert outcomes.count("served") >= 3  # bounded queue kept serving
+        s = b.stats()
+        assert s["shed_queue_full"] == outcomes.count("shed")
+        assert s["served_requests"] == outcomes.count("served")
+    finally:
+        b.drain(timeout=10)
+
+
+def test_drain_serves_inflight_then_refuses_new_work():
+    """Shutdown contract: everything accepted before drain() is served;
+    submit() after drain raises Draining."""
+    eng = _StubEngine(delay_s=0.02)
+    b = DynamicBatcher(eng, max_batch=2, max_wait_ms=1.0,
+                       queue_depth=64).start()
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        out = b.submit(_images(1, seed=i), timeout=30)
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(10)]
+    for t in threads:
+        t.start()
+    time.sleep(0.01)  # let them enqueue
+    assert b.drain(timeout=30) is True
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 10  # accepted work drained, none dropped
+    assert b.stats()["served_requests"] == 10
+    with pytest.raises(Draining):
+        b.submit(_images(1))
+
+
+def test_malformed_request_fails_alone_at_admission():
+    b = DynamicBatcher(_StubEngine()).start()
+    try:
+        with pytest.raises(ValueError, match="expected images"):
+            b.submit(np.zeros((2, 16, 16, 3), np.uint8))
+        with pytest.raises(ValueError, match="uint8"):
+            b.submit(np.zeros((2, 32, 32, 3), np.float32))
+        with pytest.raises(ValueError, match="empty"):
+            b.submit(np.zeros((0, 32, 32, 3), np.uint8))
+    finally:
+        b.drain(timeout=5)
+
+
+def test_holdover_request_is_never_split():
+    """A request that does not fit the forming batch rides whole into the
+    next one (one request == one contiguous row block)."""
+    eng = _StubEngine(max_rows=8)
+    b = DynamicBatcher(eng, max_batch=8, max_wait_ms=30.0).start()
+    try:
+        outs = {}
+
+        def client(key, n, seed):
+            outs[key] = b.submit(_images(n, seed=seed), timeout=30)
+
+        threads = [threading.Thread(target=client, args=("a", 6, 1)),
+                   threading.Thread(target=client, args=("b", 5, 2))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert outs["a"].shape == (6, 10) and outs["b"].shape == (5, 10)
+        # 6+5 > max_batch=8: two forwards, neither split across batches.
+        assert sorted(eng.calls) in ([5, 6], [5, 8], [6, 8], [8, 8])
+    finally:
+        b.drain(timeout=5)
+
+
+# -- HTTP front end --------------------------------------------------------
+
+@pytest.fixture()
+def http_server():
+    eng = _StubEngine()
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    port = httpd.server_address[1]
+    yield f"http://127.0.0.1:{port}", batcher
+    batcher.drain(timeout=5)
+    httpd.shutdown()
+    httpd.server_close()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_http_healthz_predict_stats(http_server):
+    base, _ = http_server
+    status, health = _get(base + "/healthz")
+    assert status == 200 and health["status"] == "ok"
+    imgs = _images(2).tolist()
+    status, out = _post(base + "/predict", {"instances": imgs})
+    assert status == 200
+    assert len(out["predictions"]) == 2 and len(out["logits"][0]) == 10
+    status, stats = _get(base + "/stats")
+    assert status == 200
+    assert stats["batcher"]["served_requests"] == 1
+    assert stats["engine"]["buckets"] == [8, 32]
+
+
+def test_http_error_mapping(http_server):
+    base, batcher = http_server
+    # 413: oversized (larger than the biggest bucket).
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base + "/predict", {"instances": _images(33).tolist()})
+    assert e.value.code == 413
+    # 400: malformed pixels.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base + "/predict", {"instances": [[[[1.5] * 3] * 32] * 32]})
+    assert e.value.code == 400
+    # 404: unknown route.
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(base + "/nope")
+    assert e.value.code == 404
+    # 503 + draining healthz during shutdown.
+    batcher.drain(timeout=5)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(base + "/healthz")
+    assert e.value.code == 503
+    assert json.loads(e.value.read())["status"] == "draining"
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _post(base + "/predict", {"instances": _images(1).tolist()})
+    assert e.value.code == 503
+
+
+# -- telemetry -------------------------------------------------------------
+
+def test_serve_spans_spill_and_export_to_perfetto(tmp_path, engine8):
+    """A traced serve run spills queue_wait/batch_form/pad/h2d/forward/
+    d2h spans that the UNCHANGED obs tooling reads, reports, and exports
+    as schema-valid Perfetto trace_event JSON."""
+    from ddp_tpu.obs.export import (read_spill, to_trace_events,
+                                    validate_trace_events)
+    from ddp_tpu.obs.tracer import SpanTracer
+    spill = str(tmp_path / "serve_spill.jsonl")
+    tracer = SpanTracer(spill_path=spill)
+    old_tracer, old_seq = engine8.tracer, engine8._seq
+    engine8.tracer = tracer
+    try:
+        b = DynamicBatcher(engine8, max_wait_ms=1.0, tracer=tracer).start()
+        for n in (1, 8, 20):
+            b.submit(_images(n, seed=n), timeout=30)
+        b.drain(timeout=10)
+    finally:
+        engine8.tracer = old_tracer
+        tracer.close()
+    spans = read_spill([spill])
+    phases = {s["phase"] for s in spans}
+    assert {"queue_wait", "batch_form", "pad", "h2d", "forward",
+            "d2h"} <= phases
+    assert all(s["overlap"] for s in spans if s["phase"] == "queue_wait")
+    n_events = validate_trace_events(to_trace_events(spans))
+    assert n_events > len(spans)  # spans + metadata rows
+
+
+@pytest.mark.slow
+def test_serve_cli_end_to_end_with_sigterm_drain(tmp_path):
+    """The full ``python -m ddp_tpu.serve`` surface as a subprocess:
+    train a checkpoint, stand the server up, /healthz + /predict over
+    real HTTP, SIGTERM -> graceful drain -> exit 0, span spill on disk
+    and obs-readable."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ck = str(tmp_path / "ck.pt")
+    train = subprocess.run(
+        [sys.executable, "multigpu.py", "1", "1", "--batch_size", "8",
+         "--model", "deepnn", "--synthetic", "--synthetic_size", "32",
+         "--num_devices", "1", "--snapshot_path", ck, "--obs_off"],
+        env=env, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert train.returncode == 0, train.stderr[-2000:]
+    spill = str(tmp_path / "serve_spill.jsonl")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ddp_tpu.serve", "--snapshot_path", ck,
+         "--model", "deepnn", "--port", "0", "--buckets", "8",
+         "--num_devices", "1", "--trace_spill", spill],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+    try:
+        line = proc.stdout.readline()  # the serving banner names the port
+        assert "serving deepnn on http://" in line, line
+        base = line.split("on ")[1].split(" ")[0].rstrip("/")
+        status, health = _get(base + "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["checkpoint"]["file"] == ck
+        status, out = _post(base + "/predict",
+                            {"instances": _images(3).tolist()})
+        assert status == 200 and len(out["predictions"]) == 3
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    from ddp_tpu.obs.export import read_spill
+    spans = read_spill([spill])
+    assert {"forward", "h2d"} <= {s["phase"] for s in spans}
+
+
+def test_engine_rejects_bad_input_shapes(engine8):
+    with pytest.raises(ValueError, match="expected images"):
+        engine8.forward(np.zeros((2, 16, 16, 3), np.uint8))
+    with pytest.raises(ValueError, match="uint8"):
+        engine8.forward(np.zeros((2, 32, 32, 3), np.float32))
+    with pytest.raises(RequestTooLarge):
+        engine8.forward(_images(33))
