@@ -106,6 +106,18 @@ def _parse_args():
                         "every window lands in window_ms_per_step with "
                         "best/spread fields, so a noisy link is visible "
                         "in the record itself")
+    p.add_argument("--mesh_shape", default=None, metavar="D,M",
+                   help="2-D (data x model) tensor-parallel mesh for the "
+                        "steady-state step bench (parallel/tp/): "
+                        "--batch_size is per DATA shard; the plan comes "
+                        "from the model's TP_RECIPE")
+    p.add_argument("--tp_sweep", default=None, metavar="M1,M2,...",
+                   help="Tensor-parallel sweep: one child per model-axis "
+                        "size M over the same device total (data axis = "
+                        "total/M), at FIXED GLOBAL BATCH --batch_size — "
+                        "records ms/step + MFU per mesh shape (the "
+                        "model-axis cost curve; chip paste in RUNBOOK "
+                        "section 10).  Uses --sweep_platform like --sweep")
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size (default: all visible devices)")
     p.add_argument("--batch_sweep", default=None, metavar="B1,B2,...",
@@ -236,13 +248,17 @@ def main() -> None:
     args = _parse_args()
     if args.dump_hlo and (args.sweep or args.pipeline or args.e2e
                           or args.batch_sweep or args.stream_attr
-                          or args.serve):
+                          or args.serve or args.tp_sweep):
         raise SystemExit("--dump_hlo only applies to the steady-state step "
                          "bench (it dumps the timed step/scan program); it "
                          "has no program to dump in --sweep/--batch_sweep/"
-                         "--pipeline/--e2e/--stream_attr/--serve modes")
+                         "--pipeline/--e2e/--stream_attr/--serve/--tp_sweep "
+                         "modes")
     if args.serve:
         _bench_serve(args)
+        return
+    if args.tp_sweep:
+        _bench_tp_sweep(args)
         return
     if args.batch_sweep:
         _bench_batch_sweep(args)
@@ -281,10 +297,27 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
     ``--dispatch scan`` issues the window as ONE jitted ``lax.scan`` (the
     resident-epoch mode's dispatch pattern).  With ``extras``, the other
     flavor is also measured and reported (stderr)."""
-    mesh = make_mesh(args.num_devices)
+    plan = None
+    # getattr: callers hand-build Namespaces without the tp flag
+    # (tests/test_round3_fixes.py's precedent for late-added knobs).
+    mesh_shape = getattr(args, "mesh_shape", None)
+    if mesh_shape:
+        try:
+            d, m = (int(x) for x in mesh_shape.split(","))
+        except ValueError:
+            raise SystemExit(f"--mesh_shape wants 'D,M' (e.g. 2,4), got "
+                             f"{mesh_shape!r}")
+        d_m = (d, m)
+        mesh = make_mesh(shape=d_m)
+    else:
+        mesh = make_mesh(args.num_devices)
     n_chips = mesh.devices.size
     model = get_model(args.model)
     params, stats = model.init(jax.random.key(0))
+    if mesh_shape:
+        from ddp_tpu.parallel.tp.plan import plan_for_model
+        plan = plan_for_model(args.model, jax.device_get(params), stats,
+                              model_size=d_m[1])
     schedule = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
                                  steps_per_epoch=98)
     compute_dtype = jnp.bfloat16 if bf16 else None
@@ -292,15 +325,22 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         from ddp_tpu.train.step import TrainState
         from ddp_tpu.train.zero import init_opt_shard, make_train_step_zero
         step_fn = make_train_step_zero(model, SGDConfig(), schedule, mesh,
-                                       compute_dtype=compute_dtype)
-        state = TrainState(params, stats, init_opt_shard(params, mesh),
+                                       compute_dtype=compute_dtype,
+                                       plan=plan)
+        state = TrainState(params, stats,
+                           init_opt_shard(params, mesh, plan=plan),
                            jnp.zeros((), jnp.int32))
     else:
         step_fn = make_train_step(model, SGDConfig(), schedule, mesh,
-                                  compute_dtype=compute_dtype)
+                                  compute_dtype=compute_dtype, plan=plan)
         state = init_train_state(params, stats)
+    if plan is not None:
+        from ddp_tpu.parallel.tp.plan import state_shardings
+        state = jax.device_put(
+            state, state_shardings(plan, mesh, zero=args.shard_update))
 
-    global_batch = args.batch_size * n_chips
+    from ddp_tpu.parallel.mesh import data_axis_size
+    global_batch = args.batch_size * data_axis_size(mesh)
     ds, _ = synthetic(n_train=global_batch, n_test=1)
     batch = shard_batch({"image": ds.images.astype(np.float32) / 255.0,
                          "label": ds.labels}, mesh)
@@ -330,14 +370,18 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         sps_chip = global_batch * args.steps / dt / n_chips
         # vs_baseline only against a MATCHING-mode recorded constant (a
         # cross-mode ratio misreads as regression/progress — VERDICT r2
-        # weak #2); no constant is recorded for the zero-sharded step yet.
-        base = (None if args.shard_update
+        # weak #2); no constant is recorded for the zero-sharded or
+        # tensor-parallel steps yet.
+        base = (None if args.shard_update or mesh_shape
                 else BASELINE_BENCH_BF16 if bf16 else BASELINE_BENCH)
         vs = sps_chip / base if base else 1.0
+        mesh_tag = (f"mesh {mesh_shape} (data x model), "
+                    if mesh_shape else "")
         rec = {
             "metric": f"{args.model} train samples/sec/chip "
                       f"(batch {args.batch_size}/chip, "
                       f"{'bf16' if bf16 else 'fp32'}, {n_chips} chip(s), "
+                      f"{mesh_tag}"
                       f"{'zero-sharded update, ' if args.shard_update else ''}"
                       f"{tag})",
             "value": round(sps_chip, 2),
@@ -934,6 +978,65 @@ def _bench_sweep(args) -> None:
         "unit": f"per-chip efficiency at {counts[-1]} vs {counts[0]} devices",
         "vs_baseline": 1.0,
         "samples_per_sec_per_chip": {str(n): per_n[n] for n in counts},
+    }))
+
+
+def _bench_tp_sweep(args) -> None:
+    """Tensor-parallel mesh-shape sweep at FIXED GLOBAL BATCH: one child
+    per model-axis size M over the same device total (data axis =
+    total/M), recording ms/step and MFU per mesh shape — the measured
+    cost of trading data-parallel width for model-parallel width (the
+    row-psum collectives + thinner per-shard matmuls).  Emits ONE JSON
+    line whose ``tp_sweep`` dict is keyed by mesh shape ("8x1", "4x2",
+    "2x4"); committed CPU-box record: BENCH_r07.json (chip paste in
+    RUNBOOK section 10).  m=1 children run the REAL tp code path on a
+    (N,1) mesh, so the m>1 deltas are collective cost, not plumbing."""
+    ms = sorted(int(x) for x in args.tp_sweep.split(","))
+    total = args.num_devices or jax.device_count()
+    global_batch = args.batch_size
+    per: dict = {}
+    for m in ms:
+        if total % m:
+            raise SystemExit(f"--tp_sweep: model axis {m} does not divide "
+                             f"the device total {total}")
+        d = total // m
+        if global_batch % d:
+            raise SystemExit(f"--tp_sweep: global batch {global_batch} not "
+                             f"divisible by the {d}-way data axis at m={m}")
+        env = dict(os.environ)
+        child = [sys.executable, os.path.abspath(__file__),
+                 "--model", args.model,
+                 "--batch_size", str(global_batch // d),
+                 "--steps", str(args.steps), "--warmup", str(args.warmup),
+                 "--repeats", str(args.repeats),
+                 "--mesh_shape", f"{d},{m}",
+                 "--no_bf16", "--primary_only", "--dispatch", args.dispatch]
+        child += ["--bf16"] if args.bf16 else []
+        child += ["--shard_update"] if args.shard_update else []
+        if args.sweep_platform == "cpu":
+            from ddp_tpu.utils.platform import cpu_device_env
+            env = cpu_device_env(total, env)
+        rec = _run_child(child, env, f"tp sweep child m={m}")
+        per[f"{d}x{m}"] = {
+            "ms_per_step": rec["median_ms_per_step"],
+            "best_window_ms_per_step": rec["best_window_ms_per_step"],
+            "samples_per_sec_per_chip": rec["value"],
+            "mfu": rec.get("mfu"),
+        }
+    shapes = [f"{total // m}x{m}" for m in ms]
+    base_ms = per[shapes[0]]["ms_per_step"]
+    last_ms = per[shapes[-1]]["ms_per_step"]
+    print(json.dumps({
+        "metric": f"{args.model} tensor-parallel mesh sweep "
+                  f"({args.sweep_platform} mesh, global batch "
+                  f"{global_batch}, {total} devices, "
+                  f"{'bf16' if args.bf16 else 'fp32'}, "
+                  f"{'zero-sharded update, ' if args.shard_update else ''}"
+                  f"shapes {shapes})",
+        "value": round(base_ms / last_ms, 4) if last_ms else 0.0,
+        "unit": f"ms/step ratio, {shapes[0]} vs {shapes[-1]} (data x model)",
+        "vs_baseline": 1.0,
+        "tp_sweep": per,
     }))
 
 

@@ -84,6 +84,17 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size override (default: entry-point specific)")
+    p.add_argument("--mesh_shape", default=None, metavar="D,M",
+                   help="2-D (data x model) mesh for tensor-model "
+                        "parallelism (parallel/tp/): D-way data parallel "
+                        "x M-way model parallel over the first D*M "
+                        "devices, params sharded per the model's "
+                        "TP_RECIPE (plan table printed at startup; "
+                        "python -m ddp_tpu.parallel.tp shows it offline). "
+                        "Batches split over the data axis only; "
+                        "checkpoints stay canonical, so snapshots "
+                        "interchange with any other mesh shape (incl. "
+                        "1-D serving).  Default: 1-D data-parallel mesh")
     p.add_argument("--spawn", default=0, type=int, metavar="N",
                    help="Fork N local processes wired by a fresh rendezvous "
                         "and run this exact command in each (the reference's "
@@ -424,8 +435,24 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     """The reference ``main()`` body proper (multigpu.py:224-248), between
     rendezvous and teardown — both owned by :func:`run`."""
     _enable_compilation_cache()
-    mesh = make_mesh(args.num_devices or num_devices)
-    n_replicas = mesh.devices.size
+    if args.mesh_shape:
+        try:
+            d, m = (int(x) for x in args.mesh_shape.split(","))
+        except ValueError:
+            raise SystemExit(
+                f"--mesh_shape wants 'D,M' (e.g. 2,4), got "
+                f"{args.mesh_shape!r}")
+        if args.num_devices and args.num_devices != d * m:
+            raise SystemExit(
+                f"--num_devices {args.num_devices} contradicts "
+                f"--mesh_shape {d},{m} (= {d * m} devices); drop one")
+        mesh = make_mesh(shape=(d, m))
+    else:
+        mesh = make_mesh(args.num_devices or num_devices)
+    # Batch math divides by the DATA axis only: on a 2-D mesh the model
+    # axis replicates the batch (parallel/mesh.py:data_axis_size).
+    from .parallel.mesh import data_axis_size
+    n_replicas = data_axis_size(mesh)
 
     if args.synthetic:
         train_ds, test_ds = cifar10.synthetic(
@@ -451,6 +478,19 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     else:
         params, batch_stats = model.init(jax.random.key(args.seed))
     compute_dtype = jnp.bfloat16 if args.bf16 else None
+
+    # Tensor-parallel plan (parallel/tp/plan.py): resolved against the
+    # LIVE param pytree so the divisibility validation and the printed
+    # table describe exactly what will train; built for any --mesh_shape
+    # mesh (m=1 included — the tp code path then runs trivially).
+    tp_plan = None
+    if args.mesh_shape:
+        from .parallel.mesh import model_axis_size
+        from .parallel.tp.plan import format_plan_table, plan_for_model
+        tp_plan = plan_for_model(args.model, params, batch_stats,
+                                 model_size=model_axis_size(mesh))
+        if jax.process_index() == 0:
+            print(format_plan_table(tp_plan))
 
     # Each host materialises/augments only its own chips' rows (the per-host
     # shard DistributedSampler semantics, multigpu.py:153); single-host this
@@ -556,7 +596,7 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         return _run_guarded(args, preemption, metrics, model, train_loader,
                             params, batch_stats, mesh, lr_schedule,
                             compute_dtype, device_augment, test_ds,
-                            n_replicas, local_replicas, tracer)
+                            n_replicas, local_replicas, tracer, tp_plan)
     finally:
         # Handlers must not outlive the run even when construction (e.g. a
         # resume with every checkpoint torn) raises before training starts
@@ -570,7 +610,7 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
 def _run_guarded(args, preemption, metrics, model, train_loader, params,
                  batch_stats, mesh, lr_schedule, compute_dtype,
                  device_augment, test_ds, n_replicas, local_replicas,
-                 tracer) -> float:
+                 tracer, tp_plan=None) -> float:
     """The trainer-lifetime tail of :func:`_run_body`, inside the
     preemption guard's install/uninstall bracket."""
     from .resilience.watchdog import Watchdog
@@ -652,7 +692,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       watchdog=watchdog, preemption=preemption,
                       prefetch_depth=args.prefetch_depth,
                       prefetch_workers=args.prefetch_workers,
-                      prefetch_stats=pstats, tracer=tracer, live=live)
+                      prefetch_stats=pstats, tracer=tracer, live=live,
+                      tp_plan=tp_plan)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real
@@ -670,6 +711,10 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         # reference evaluates the very model it trained, multigpu.py:247)
         # — under --bf16 that is bf16, which also halves eval's HBM
         # traffic; params themselves are stored fp32 either way.
+        # ``plan`` is threaded only when a tp plan exists: the 1-D call
+        # keeps the established evaluate()/evaluate_resident() signature
+        # (which tests and callers monkeypatch/spy on).
+        tp_kw = {} if tp_plan is None else {"plan": tp_plan}
         if args.resident:
             from .data.resident import ResidentData
             from .train.evaluate import evaluate_resident
@@ -678,10 +723,11 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
             return evaluate_resident(
                 model, trainer.state.params, trainer.state.batch_stats,
                 resident_test_cache[0], eval_loader, mesh,
-                compute_dtype=compute_dtype)
+                compute_dtype=compute_dtype, **tp_kw)
         return evaluate(model, trainer.state.params,
                         trainer.state.batch_stats, eval_loader, mesh,
-                        compute_dtype=compute_dtype, progress=progress)
+                        compute_dtype=compute_dtype, progress=progress,
+                        **tp_kw)
 
     last_periodic_eval: list = []  # [(epoch, accuracy)] — newest only
 

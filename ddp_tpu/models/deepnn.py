@@ -26,6 +26,21 @@ DROPOUT_RATE = 0.1  # singlegpu.py:36
 # (in_ch, out_ch) of the four 3x3 convs; 'M' = maxpool2 (singlegpu.py:21-32)
 _FEATURES = [(3, 128), (128, 64), "M", (64, 64), (64, 32), "M"]
 
+# Tensor-parallel recipe (parallel/tp/plan.py): back-to-back blocks pair
+# column-then-row so the column-sharded activation feeds the row layer
+# directly and only the row output needs a psum over ``model``.  ONE
+# source of truth: the planner derives the per-leaf PartitionSpecs from
+# this mapping, and apply() below consults it for which convs/linears run
+# row-parallel under ``tp_axis`` — they cannot drift.
+TP_RECIPE = {
+    "features/conv0": "column",
+    "features/conv1": "row",
+    "features/conv2": "column",
+    "features/conv3": "row",
+    "classifier/linear0": "column",
+    "classifier/linear1": "row",
+}
+
 Params = Dict[str, Any]
 
 
@@ -60,8 +75,20 @@ def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Params, Dict]:
 def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
           rng: Optional[jax.Array] = None,
           compute_dtype: Optional[jnp.dtype] = None,
+          tp_axis: Optional[str] = None,
           ) -> Tuple[jax.Array, Dict]:
+    """Forward pass.  With ``tp_axis`` set (inside a shard_map over that
+    mesh axis, params sharded per TP_RECIPE), the row-parallel members run
+    through the tp wrappers — partial sums psum'd over ``tp_axis``, bias
+    after the reduction — and dropout draws the full-width mask so its
+    bits match the unsharded run (parallel/tp/layers.py).  Column-parallel
+    members are locally byte-identical to the unsharded ops, so they need
+    no branching at all."""
     del batch_stats
+    if tp_axis is not None:
+        from ..parallel.tp.layers import (column_conv2d, column_linear,
+                                          row_conv2d, row_linear,
+                                          sharded_dropout)
     cd = compute_dtype or x.dtype
     x = x.astype(cd)
     idx = 0
@@ -70,19 +97,36 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
             x = max_pool(x, 2, 2)
             continue
         conv = params["features"][f"conv{idx}"]
-        x = conv2d(x, conv["kernel"].astype(cd), conv["bias"].astype(cd),
-                   stride=1, padding=1)
+        k, b = conv["kernel"].astype(cd), conv["bias"].astype(cd)
+        if tp_axis is None:
+            x = conv2d(x, k, b, stride=1, padding=1)
+        elif TP_RECIPE[f"features/conv{idx}"] == "row":
+            x = row_conv2d(x, k, b, tp_axis, stride=1, padding=1)
+        else:
+            x = column_conv2d(x, k, b, tp_axis, stride=1, padding=1)
         x = jax.nn.relu(x)
         idx += 1
     x = x.reshape(x.shape[0], -1)  # [N,8,8,32] -> [N,2048] (NHWC order)
     cls = params["classifier"]
-    x = linear(x, cls["linear0"]["weight"].astype(cd),
-               cls["linear0"]["bias"].astype(cd))
+    w0, b0 = (cls["linear0"]["weight"].astype(cd),
+              cls["linear0"]["bias"].astype(cd))
+    if tp_axis is not None:
+        x = column_linear(x, w0, b0, tp_axis)
+    else:
+        x = linear(x, w0, b0)
     x = jax.nn.relu(x)
     if train:
         if rng is None:
             raise ValueError("DeepNN needs an rng for dropout in train mode")
-        x = dropout(rng, x, DROPOUT_RATE, train=True)
-    logits = linear(x, cls["linear1"]["weight"].astype(cd),
-                    cls["linear1"]["bias"].astype(cd))
+        if tp_axis is not None:
+            x = sharded_dropout(rng, x, DROPOUT_RATE, train=True,
+                                axis_name=tp_axis)
+        else:
+            x = dropout(rng, x, DROPOUT_RATE, train=True)
+    w1, b1 = (cls["linear1"]["weight"].astype(cd),
+              cls["linear1"]["bias"].astype(cd))
+    if tp_axis is not None:
+        logits = row_linear(x, w1, b1, tp_axis)
+    else:
+        logits = linear(x, w1, b1)
     return logits.astype(jnp.float32), {}

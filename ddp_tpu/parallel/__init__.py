@@ -1,9 +1,11 @@
-from .mesh import (DATA_AXIS, batch_sharding, local_batch_slice, make_mesh,
+from .mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding, data_axis_size,
+                   local_batch_slice, make_mesh, model_axis_size,
                    replicated_sharding)
 from .dist import initialize, process_count, process_index, shutdown
 
 __all__ = [
-    "DATA_AXIS", "batch_sharding", "local_batch_slice", "make_mesh",
+    "DATA_AXIS", "MODEL_AXIS", "batch_sharding", "data_axis_size",
+    "local_batch_slice", "make_mesh", "model_axis_size",
     "replicated_sharding", "initialize", "process_count", "process_index",
     "shutdown",
 ]

@@ -10,38 +10,72 @@ all-reduce over ICI (DCN across slices) — there is no NCCL-like library to
 manage and no per-rank process fan-out; one process per *host* drives all
 its local chips SPMD.
 
-The mesh is deliberately 1-D for parity with the reference (DP is the only
-parallelism it has — SURVEY.md §2 checklist), but every consumer takes the
-mesh as an argument so a second (``model``) axis can be added without
-touching the train step's callers.
+The default mesh stays 1-D for parity with the reference (DP is the only
+parallelism it has — SURVEY.md §2 checklist); ``make_mesh(shape=(d, m))``
+adds the promised second ``model`` axis (tensor-model parallelism,
+ddp_tpu/parallel/tp/) without touching any 1-D caller: batches stay split
+along ``data`` only (replicated over ``model``), and the per-leaf parameter
+shardings come from the tp planner's PartitionSpecs rather than blanket
+replication.
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
 
 
 def make_mesh(num_devices: Optional[int] = None,
-              devices: Optional[list] = None) -> Mesh:
-    """1-D data-parallel mesh over ``num_devices`` (default: all) chips.
+              devices: Optional[list] = None,
+              shape: Optional[Tuple[int, int]] = None) -> Mesh:
+    """Device mesh: 1-D data-parallel by default, 2-D (data × model) on
+    request.
 
     ``make_mesh(1)`` is the singlegpu.py path, ``make_mesh()`` the
     multigpu.py path — the reference's one structural diff (SURVEY.md §1)
-    expressed as a mesh shape.
+    expressed as a mesh shape.  ``make_mesh(shape=(d, m))`` builds the
+    tensor-parallel 2-D mesh with named ``(data, model)`` axes over the
+    first ``d*m`` devices; ``shape=(d, 1)`` is a genuine 2-D mesh (the
+    tp code paths run, trivially) — the 1-D default is untouched.
     """
     if devices is None:
         devices = jax.devices()
+    if shape is not None:
+        if num_devices is not None:
+            raise ValueError("pass num_devices or shape, not both")
+        d, m = int(shape[0]), int(shape[1])
+        if d < 1 or m < 1:
+            raise ValueError(f"mesh shape must be positive, got {shape}")
+        if d * m > len(devices):
+            raise ValueError(
+                f"mesh shape {d}x{m} needs {d * m} devices, have "
+                f"{len(devices)}")
+        return Mesh(np.asarray(devices[:d * m]).reshape(d, m),
+                    (DATA_AXIS, MODEL_AXIS))
     if num_devices is not None:
         if num_devices > len(devices):
             raise ValueError(
                 f"requested {num_devices} devices, have {len(devices)}")
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), (DATA_AXIS,))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Number of batch shards — the ``data`` axis extent.  THE divisor for
+    every piece of batch math: on a 2-D mesh the batch is split over
+    ``data`` only (replicated over ``model``), so ``mesh.devices.size``
+    overcounts by the model-axis factor."""
+    return int(dict(mesh.shape).get(DATA_AXIS, 1))
+
+
+def model_axis_size(mesh: Mesh) -> int:
+    """Model-axis extent (1 on the default 1-D mesh)."""
+    return int(dict(mesh.shape).get(MODEL_AXIS, 1))
 
 
 _SCAN_UNROLL_CAP = 32
@@ -90,15 +124,27 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def local_replica_ids(mesh: Mesh) -> list:
-    """Flat-mesh positions of THIS process's devices, in mesh order — the
-    replica ids this process feeds (loaders' ``local_replicas``) and the
-    one definition the per-process assembly order hangs on
+    """``data``-axis positions of THIS process's devices, in mesh order —
+    the replica ids this process feeds (loaders' ``local_replicas``) and
+    the one definition the per-process assembly order hangs on
     (:func:`assemble_from_local` assumes ascending mesh order).  Asymmetric
     topologies make the blocks unequal, so every consumer must derive
     them from the mesh like this rather than from range arithmetic on a
-    uniform per-host count."""
-    return [i for i, d in enumerate(mesh.devices.flat)
-            if d.process_index == jax.process_index()]
+    uniform per-host count.
+
+    On a 2-D (data × model) mesh a "replica" is a data-axis ROW (its
+    ``model``-axis devices all consume the same batch shard), so the ids
+    are the distinct data coordinates this process owns devices in — NOT
+    flat device positions, which would overcount by the model-axis factor
+    (the regression tests/test_tp.py pins)."""
+    pid = jax.process_index()
+    if mesh.devices.ndim == 1:
+        return [i for i, d in enumerate(mesh.devices.flat)
+                if d.process_index == pid]
+    data_dim = mesh.axis_names.index(DATA_AXIS)
+    rows = np.moveaxis(mesh.devices, data_dim, 0)
+    return [i for i in range(rows.shape[0])
+            if any(d.process_index == pid for d in rows[i].flat)]
 
 
 def assemble_from_local(sharding: NamedSharding, v, axis: int) -> jax.Array:
@@ -109,14 +155,30 @@ def assemble_from_local(sharding: NamedSharding, v, axis: int) -> jax.Array:
     which real pods can have even though the reference's mp.spawn fan-out
     never does (multigpu.py:262-263).  Each of this process's addressable
     mesh devices holds the same per-replica extent, so the global extent is
-    ``local_extent / n_local * n_total``."""
-    n_local = len(sharding.addressable_devices)
-    if n_local == 0:
+    ``local_extent / n_local * n_total``.
+
+    Shard counts are AXIS-AWARE: they come from the spec's entry for
+    ``axis`` (distinct shard positions along the mesh axes that actually
+    split it), not from raw device counts — on a 2-D (data × model) mesh a
+    ``P(data)`` batch is replicated over ``model``, so counting devices
+    would inflate both the local and the global block count by the
+    model-axis factor (regression-pinned in tests/test_tp.py)."""
+    if len(sharding.addressable_devices) == 0:
         raise ValueError(
             f"process {jax.process_index()} owns no devices of this mesh; "
             "it cannot contribute process-local data (every participating "
             "process must hold at least one mesh device)")
-    n_total = sharding.mesh.devices.size
+    mesh = sharding.mesh
+    entry = sharding.spec[axis] if axis < len(sharding.spec) else None
+    names = ((entry,) if isinstance(entry, str) else tuple(entry or ()))
+    dims = [mesh.axis_names.index(n) for n in names]
+    shape_d = dict(mesh.shape)
+    n_total = int(np.prod([shape_d[n] for n in names])) if names else 1
+    pid = jax.process_index()
+    local = {tuple(np.asarray(pos)[dims])
+             for pos in np.ndindex(mesh.devices.shape)
+             if mesh.devices[pos].process_index == pid}
+    n_local = len(local)
     shape = list(v.shape)
     if shape[axis] % n_local:
         raise ValueError(
@@ -160,10 +222,17 @@ def process_min_mib(mesh: Mesh, value_bytes: Optional[int]) -> Optional[int]:
 
 
 def local_batch_slice(global_batch: int, mesh: Mesh) -> int:
-    """Per-host slice of a global batch (multi-host data feeding)."""
-    if global_batch % mesh.devices.size:
+    """Per-host slice of a global batch (multi-host data feeding).
+
+    Batch math uses the ``data`` axis size ONLY: on a 2-D (data × model)
+    mesh the batch is split over ``data`` and replicated over ``model``,
+    so dividing by the raw device count would shrink every shard by the
+    model-axis factor (and reject batches a (2,4) mesh handles fine —
+    the regression tests/test_tp.py pins both)."""
+    n_shards = data_axis_size(mesh)
+    if global_batch % n_shards:
         raise ValueError(
-            f"global batch {global_batch} not divisible by mesh size "
-            f"{mesh.devices.size}")
-    per_device = global_batch // mesh.devices.size
-    return per_device * jax.local_device_count()
+            f"global batch {global_batch} not divisible by the mesh's "
+            f"{n_shards}-way data axis")
+    per_shard = global_batch // n_shards
+    return per_shard * len(local_replica_ids(mesh))

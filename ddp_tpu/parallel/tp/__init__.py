@@ -1,0 +1,12 @@
+"""Tensor-model parallelism over the 2-D (data × model) mesh.
+
+``plan.py`` is the sharding planner (declarative layer rules -> per-leaf
+PartitionSpecs + a human-readable plan table); ``layers.py`` is the sharded
+compute (column/row-parallel dense and conv over the ops/layers.py
+primitives, with the row-parallel output ``psum`` fused inside the jitted
+step).  See the package docstrings for the axis-correctness contract.
+"""
+from .plan import TPPlan, format_plan_table, plan_for_model, state_shardings
+
+__all__ = ["TPPlan", "format_plan_table", "plan_for_model",
+           "state_shardings"]

@@ -1,0 +1,34 @@
+"""``python -m ddp_tpu.parallel.tp`` — print a model's sharding plan table.
+
+The offline view of what the CLI prints at startup under ``--mesh_shape``:
+resolve the model's TP_RECIPE against a fresh param pytree at the given
+model-axis size, validate it, and print the plan table (exit non-zero on
+an infeasible plan).  CI schema-checks this output.
+"""
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from .plan import format_plan_table, plan_for_model
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.parallel.tp",
+        description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="deepnn",
+                   choices=["vgg", "deepnn", "resnet18"])
+    p.add_argument("--model_axis", default=4, type=int, metavar="M",
+                   help="model-axis size to plan for (default 4)")
+    args = p.parse_args()
+    from ...models import get_model
+    params, batch_stats = get_model(args.model).init(jax.random.key(0))
+    plan = plan_for_model(args.model, params, batch_stats,
+                          model_size=args.model_axis)
+    print(format_plan_table(plan))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Sharded compute for tensor-model parallelism: column/row-parallel dense
+and conv wrappers over the ``ops/layers.py`` primitives.
+
+The Megatron/Mesh-TensorFlow pairing (PAPERS.md arxiv 1811.02084) on the
+``model`` axis of a 2-D (data × model) mesh:
+
+- **column-parallel**: the weight's OUTPUT dimension is sharded, so each
+  model shard computes a feature *slice* of the layer's output from the
+  full (replicated) input.  The local FORWARD is byte-identical to the
+  unsharded op on the kernel slice; the wrapper's real job is the
+  backward — :func:`column_input` (Megatron's "f") sums the input
+  cotangent over ``model``, because each shard's backward contributes
+  only its weight slice's share of dx.
+- **row-parallel**: the weight's INPUT dimension is sharded, consuming the
+  column-sharded activation directly (no gather between the pair); each
+  shard produces a PARTIAL sum over its input slice and the full output is
+  ``psum`` over ``model`` — fused inside the jitted step, where XLA lowers
+  it onto ICI.  The bias is replicated and added AFTER the psum (adding a
+  per-shard bias would count it model-axis-size times).
+
+Axis-correctness contract (the whole game): every collective here reduces
+over the ``model`` axis ONLY; the gradient ``pmean``/``psum`` of the train
+steps stays on ``data`` only (train/step.py, train/zero.py).  The
+row-parallel forward psum carries a custom transpose
+(:func:`psum_keepgrad`): its output is replicated over ``model``
+downstream, so the adjoint of the shard-sum is the IDENTITY on the
+cotangent.  The runtime's own psum transpose is another psum — correct for
+varying cotangents, but a silent ``model``-axis-size overcount for the
+replicated ones every row-parallel layer produces (and each row layer on
+the path would multiply again).  The m=1 bit-identity and 1-D-parity tests
+(tests/test_tp.py) pin this numerically.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.layers import conv2d, linear
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _psum_keepgrad(axis_name: str, x: jax.Array) -> jax.Array:
+    # Nondiff axis name first — the custom_vjp convention ops/layers.py's
+    # bn_relu already follows.
+    return lax.psum(x, axis_name)
+
+
+def _psum_keepgrad_fwd(axis_name, x):
+    return lax.psum(x, axis_name), None
+
+
+def _psum_keepgrad_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+_psum_keepgrad.defvjp(_psum_keepgrad_fwd, _psum_keepgrad_bwd)
+
+
+def psum_keepgrad(x: jax.Array, axis_name: str) -> jax.Array:
+    """``lax.psum`` over ``axis_name`` whose transpose is the identity —
+    the correct adjoint when the summed output is consumed replicated over
+    that axis (every row-parallel layer's situation).  See the module
+    docstring for why the default psum transpose would overcount."""
+    return _psum_keepgrad(axis_name, x)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _column_input(axis_name: str, x: jax.Array) -> jax.Array:
+    return x
+
+
+def _column_input_fwd(axis_name, x):
+    return x, None
+
+
+def _column_input_bwd(axis_name, _res, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+_column_input.defvjp(_column_input_fwd, _column_input_bwd)
+
+
+def column_input(x: jax.Array, axis_name: str) -> jax.Array:
+    """Megatron's "f" operator — the column-parallel layers' dual of
+    :func:`psum_keepgrad`: identity forward, ``psum`` over ``axis_name``
+    backward.  A column layer's input is REPLICATED over ``model`` while
+    its weight slice differs per shard, so each shard's backward produces
+    only its slice's *contribution* to the input cotangent; the sum over
+    shards is the real dx.  Without this psum every parameter upstream of
+    a column layer silently trains on a 1/m-ish gradient (caught by the
+    per-leaf gradient parity test in tests/test_tp.py).  At m=1 the psum
+    is over one shard — identity, bit-for-bit."""
+    return _column_input(axis_name, x)
+
+
+def column_linear(x: jax.Array, weight: jax.Array,
+                  bias: Optional[jax.Array], axis_name: str) -> jax.Array:
+    """Column-parallel dense: ``weight`` is the ``[in, out/m]`` shard, the
+    output is the matching feature slice.  The forward math is
+    ``ops.linear`` on the slice (full-length contractions — every output
+    element is the same dot product the unsharded layer computes); the
+    wrapper's job is the BACKWARD: :func:`column_input` sums the input
+    cotangent over ``axis_name``."""
+    return linear(column_input(x, axis_name), weight, bias)
+
+
+def row_linear(x: jax.Array, weight: jax.Array,
+               bias: Optional[jax.Array], axis_name: str) -> jax.Array:
+    """Row-parallel dense: ``x`` is the column-sharded ``[..., in/m]``
+    activation, ``weight`` the ``[in/m, out]`` shard; partial products are
+    ``psum``-ed over ``axis_name`` and the replicated ``bias`` is added
+    once, after the reduction."""
+    y = psum_keepgrad(linear(x, weight, None), axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def column_conv2d(x: jax.Array, kernel: jax.Array,
+                  bias: Optional[jax.Array], axis_name: str, *,
+                  stride: int = 1, padding: int = 1) -> jax.Array:
+    """Column-parallel conv: ``kernel`` is the ``[kh, kw, in, out/m]``
+    shard, output channels are the matching slice.  Forward math is
+    ``ops.conv2d`` on the slice; :func:`column_input` carries the
+    backward's ``model``-axis sum (see :func:`column_linear`)."""
+    return conv2d(column_input(x, axis_name), kernel, bias, stride=stride,
+                  padding=padding)
+
+
+def row_conv2d(x: jax.Array, kernel: jax.Array, bias: Optional[jax.Array],
+               axis_name: str, *, stride: int = 1,
+               padding: int = 1) -> jax.Array:
+    """Row-parallel conv: ``x`` carries the column-sharded ``in/m``
+    channels, ``kernel`` is the ``[kh, kw, in/m, out]`` shard; the partial
+    channel sums are ``psum``-ed over ``axis_name``, replicated ``bias``
+    added after."""
+    y = psum_keepgrad(conv2d(x, kernel, None, stride=stride,
+                             padding=padding), axis_name)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def sharded_dropout(key: jax.Array, x: jax.Array, rate: float, train: bool,
+                    axis_name: str) -> jax.Array:
+    """Dropout on a feature-sharded activation that draws the SAME mask
+    the unsharded layer would: the full-width mask is generated on every
+    model shard (a few KB — noise next to the matmuls around it) and each
+    shard takes its own column block.  Drawing a per-shard-shaped mask
+    instead would give every shard the byte-identical mask for *different*
+    feature slices — a distribution change vs the 1-D run.  At m=1 the
+    slice is the whole mask and the expression reduces bit-for-bit to
+    ``ops.layers.dropout`` (tests/test_tp.py pins it)."""
+    if not train or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    m = lax.axis_size(axis_name)
+    local = x.shape[-1]
+    mask = jax.random.bernoulli(key, keep, x.shape[:-1] + (local * m,))
+    mask = lax.dynamic_slice_in_dim(mask, lax.axis_index(axis_name) * local,
+                                    local, axis=mask.ndim - 1)
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))

@@ -1,0 +1,219 @@
+"""The sharding planner: declarative layer rules -> per-leaf PartitionSpecs.
+
+This is the spec-driven analogue of what ``train/zero.py`` hand-rolls for
+the weight update (cf. "Automatic Cross-Replica Sharding of Weight Update
+in Data-Parallel Training", arXiv:2004.13336) applied to the parameters
+themselves on the ``model`` axis of a 2-D mesh (Mesh-TensorFlow's
+formulation, arXiv:1811.02084 — both in PAPERS.md).
+
+A model opts in by declaring a ``TP_RECIPE``: an ordered mapping from
+parameter-subtree path (``features/conv0``) to a parallel style —
+``column`` (output dimension sharded) or ``row`` (input dimension sharded,
+output psum'd).  Back-to-back blocks pair column-then-row so no gather is
+needed between them; everything unmatched (norm scales/biases, the
+row-parallel biases added after the psum, BN running stats) stays
+replicated.  The planner walks the model's *actual* param pytree, emits a
+``PartitionSpec`` per leaf, validates every sharded dimension divides by
+the ``model``-axis size (all violations reported at once, by name), and
+refuses rules that match nothing — the drift guard between a recipe and
+the model it describes.
+
+``format_plan_table`` renders the human-readable plan (printed by the CLI
+at startup, schema-checked in CI); ``state_shardings`` turns the plan into
+the per-leaf ``NamedSharding`` tree the jitted steps and the Trainer's
+``device_put`` use — the specs asserted on live arrays in
+tests/test_tp.py.
+"""
+from __future__ import annotations
+
+import importlib
+from typing import Any, Dict, List, NamedTuple, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..mesh import DATA_AXIS, MODEL_AXIS, model_axis_size
+
+# Model registry name -> module name where it differs.
+_MODULE_FOR = {"resnet18": "resnet"}
+
+STYLES = ("column", "row")
+
+
+class TPPlan(NamedTuple):
+    """A resolved sharding plan for one (model, model-axis-size) pair."""
+    model_name: str
+    model_size: int
+    param_specs: Any   # pytree of PartitionSpec, same structure as params
+    stats_specs: Any   # pytree of PartitionSpec for batch_stats (replicated)
+    rows: Tuple       # ((path, style, shape, spec), ...) in table order
+
+
+def _recipe_for(model_name: str) -> Dict[str, str]:
+    mod = importlib.import_module(
+        f"ddp_tpu.models.{_MODULE_FOR.get(model_name, model_name)}")
+    recipe = getattr(mod, "TP_RECIPE", None)
+    if not recipe:
+        raise ValueError(
+            f"model {model_name!r} declares no TP_RECIPE; tensor "
+            "parallelism needs the model to name its column/row-parallel "
+            "layer pairs (see models/deepnn.py) — run it on a 1-D mesh, "
+            "or add a recipe")
+    bad = [s for s in recipe.values() if s not in STYLES]
+    if bad:
+        raise ValueError(f"unknown TP styles {bad} in {model_name}'s "
+                         f"TP_RECIPE; expected one of {STYLES}")
+    return recipe
+
+
+def _walk(tree: Any, prefix: str, out: List[Tuple[str, Any]]) -> None:
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _walk(tree[k], f"{prefix}/{k}" if prefix else k, out)
+    else:
+        out.append((prefix, tree))
+
+
+def _leaf_spec(style: str, ndim: int) -> P:
+    """The spec a ``column``/``row`` layer's leaf gets, by rank: the
+    output dimension is last (conv HWIO / linear [in, out] — the one
+    layout the whole codebase uses), the input dimension second-to-last.
+    Rank-1 leaves are biases: sharded with the output for ``column``,
+    replicated for ``row`` (added once, after the psum)."""
+    if ndim == 1:
+        return P(MODEL_AXIS) if style == "column" else P()
+    dim = ndim - 1 if style == "column" else ndim - 2
+    entries = [None] * ndim
+    entries[dim] = MODEL_AXIS
+    return P(*entries)
+
+
+def plan_for_model(model_name: str, params, batch_stats=None, *,
+                   model_size: int) -> TPPlan:
+    """Resolve ``model_name``'s TP_RECIPE against its live param pytree.
+
+    Raises ``ValueError`` when the model has no recipe, a rule matches no
+    parameter subtree, or any sharded dimension does not divide by
+    ``model_size`` — every violation in one message, by leaf path."""
+    if model_size < 1:
+        raise ValueError(f"model_size must be >= 1, got {model_size}")
+    recipe = _recipe_for(model_name)
+    leaves: List[Tuple[str, Any]] = []
+    _walk(params, "", leaves)
+    matched = set()
+    rows, errors = [], []
+    spec_flat: Dict[str, P] = {}
+    for path, leaf in leaves:
+        style = None
+        for prefix, s in recipe.items():
+            if path == prefix or path.startswith(prefix + "/"):
+                style, _ = s, matched.add(prefix)
+                break
+        shape = tuple(np.shape(leaf))
+        spec = P() if style is None else _leaf_spec(style, len(shape))
+        for dim, name in enumerate(spec):
+            if name == MODEL_AXIS and shape[dim] % model_size:
+                errors.append(
+                    f"  {path}: dim {dim} extent {shape[dim]} not "
+                    f"divisible by model axis size {model_size}")
+        rows.append((path, style or "replicated", shape, spec))
+        spec_flat[path] = spec
+    unmatched = [p for p in recipe if p not in matched]
+    if unmatched:
+        raise ValueError(
+            f"TP_RECIPE rules {unmatched} match no parameter of "
+            f"{model_name!r} — the recipe and the model have drifted")
+    if errors:
+        raise ValueError(
+            f"tensor-parallel plan for {model_name!r} is infeasible at "
+            f"model axis size {model_size}:\n" + "\n".join(errors))
+    param_specs = _unflatten_specs(params, spec_flat)
+    stats_specs = jax.tree_util.tree_map(lambda _: P(),
+                                         batch_stats or {})
+    return TPPlan(model_name, model_size, param_specs, stats_specs,
+                  tuple(rows))
+
+
+def _unflatten_specs(params, spec_flat: Dict[str, P]):
+    def rebuild(tree, prefix):
+        if isinstance(tree, dict):
+            return {k: rebuild(v, f"{prefix}/{k}" if prefix else k)
+                    for k, v in tree.items()}
+        return spec_flat[prefix]
+    return rebuild(params, "")
+
+
+def local_param_count(plan: TPPlan) -> int:
+    """Per-model-shard parameter count (sharded leaves contribute 1/m) —
+    the flat-vector length the ZeRO composition pads and slices
+    (train/zero.py)."""
+    n = 0
+    for _path, _style, shape, spec in plan.rows:
+        size = int(np.prod(shape)) if shape else 1
+        if any(e == MODEL_AXIS for e in spec):
+            size //= plan.model_size
+        n += size
+    return n
+
+
+def state_shardings(plan: TPPlan, mesh: Mesh, *, zero: bool = False):
+    """Per-leaf ``NamedSharding`` tree for a ``TrainState`` under this
+    plan: params/momentum follow the plan's specs (the elementwise SGD
+    update preserves them), batch_stats and the step counter are
+    replicated.  ``zero=True`` swaps the momentum for the ZeRO flat
+    buffer's ``P(model, data)`` spec (train/zero.py's [m, L] layout — the
+    spec-merge of params-along-``model`` with update-along-``data``)."""
+    if model_axis_size(mesh) != plan.model_size:
+        raise ValueError(
+            f"plan was resolved for model axis size {plan.model_size}, "
+            f"mesh has {model_axis_size(mesh)}")
+    from ...optim.sgd import SGDState
+    from ...train.step import TrainState
+    sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    params = jax.tree_util.tree_map(sh, plan.param_specs)
+    stats = jax.tree_util.tree_map(sh, plan.stats_specs)
+    opt = (SGDState(sh(P(MODEL_AXIS, DATA_AXIS))) if zero
+           else SGDState(params))
+    return TrainState(params=params, batch_stats=stats, opt_state=opt,
+                      step=sh(P()))
+
+
+def state_specs(plan: TPPlan, *, zero: bool = False):
+    """Same tree as :func:`state_shardings` but bare ``PartitionSpec``s —
+    the ``shard_map`` in/out_specs form."""
+    from ...optim.sgd import SGDState
+    from ...train.step import TrainState
+    opt = (SGDState(P(MODEL_AXIS, DATA_AXIS)) if zero
+           else SGDState(plan.param_specs))
+    return TrainState(params=plan.param_specs, batch_stats=plan.stats_specs,
+                      opt_state=opt, step=P())
+
+
+def format_plan_table(plan: TPPlan) -> str:
+    """The human-readable plan: one row per leaf (path, style, shape,
+    spec, per-shard shape), then the totals line.  First line is the
+    schema anchor CI greps for."""
+    header = (f"tensor-parallel plan: {plan.model_name} | "
+              f"model axis m={plan.model_size}")
+    cols = ("leaf", "style", "shape", "spec", "per-shard")
+    body = []
+    total = sharded = 0
+    for path, style, shape, spec in plan.rows:
+        local = tuple(s // plan.model_size if e == MODEL_AXIS else s
+                      for s, e in zip(shape,
+                                      tuple(spec) + (None,) * len(shape)))
+        size = int(np.prod(shape)) if shape else 1
+        total += size
+        if any(e == MODEL_AXIS for e in spec):
+            sharded += size
+        body.append((path, style, str(shape), str(spec), str(local)))
+    widths = [max(len(c), *(len(r[i]) for r in body))
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines = [header, fmt.format(*cols)]
+    lines += [fmt.format(*row) for row in body]
+    pct = 100.0 * sharded / max(total, 1)
+    lines.append(f"total {total:,} params | sharded {sharded:,} "
+                 f"({pct:.2f}%) | replicated {total - sharded:,}")
+    return "\n".join(lines)

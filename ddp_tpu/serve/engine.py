@@ -10,6 +10,13 @@ framework's own eval forward: the per-shard apply is
 training-loop evaluation of the same checkpoint (tests/test_serve.py
 pins bit-identity at matched bucket shapes).
 
+Mesh portability: checkpoints are canonical (replicated per-leaf) no
+matter what mesh trained them — a tensor-parallel ``--mesh_shape`` run
+GATHERS its model-sharded params at save time (train/trainer.py) — so
+this engine serves a TP-trained snapshot on its own (typically 1-D)
+serving mesh with no conversion step; tests/test_serve.py pins the
+(2,4)-train -> 1-D-serve logits against the training-side eval forward.
+
 Shape policy: requests are padded up to the smallest *bucket* (each
 bucket rounded up to a mesh-size multiple so the ``data``-axis shard_map
 sees equal shards), the bucket set is fixed at construction, and every

@@ -34,16 +34,18 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
-from ..parallel.mesh import DATA_AXIS, replicated_sharding, scan_unroll
+from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, replicated_sharding,
+                             scan_unroll)
 from .step import (TrainState, make_accum_scan, make_eval_apply,
-                   make_group_step, make_group_update, make_loss_and_grads,
-                   make_single_micro, micro_from_table)
+                   make_group_step, make_group_update, make_single_micro,
+                   make_step_wiring, micro_from_table)
 
 
 def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
                      lr_schedule: Callable[[jax.Array], jax.Array],
                      mesh: Mesh, compute_dtype=None,
-                     device_augment: bool = False, sync_bn: bool = False):
+                     device_augment: bool = False, sync_bn: bool = False,
+                     plan=None):
     """Build the jitted scan-per-epoch train function over ``mesh``.
 
     Returns ``epoch_fn(state, images, labels, idx, rng) -> (state, losses)``
@@ -55,9 +57,12 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
 
     Distinct ``idx`` shapes (e.g. the ragged final batch, 50000 % 512 != 0 —
     singlegpu.py:179 semantics) compile once each and are cached by jit.
+    ``plan`` (tp) runs the tensor-parallel per-step body inside the same
+    scan — the resident dataset stays replicated, ``idx`` stays sharded on
+    ``data`` only.
     """
-    loss_and_grads = make_loss_and_grads(model, compute_dtype=compute_dtype,
-                                         sync_bn=sync_bn)
+    loss_and_grads, st_specs, st_sh, extra = make_step_wiring(
+        model, mesh, compute_dtype, sync_bn, plan)
     update = make_group_update(sgd_config, lr_schedule)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
@@ -71,18 +76,19 @@ def make_train_epoch(model, sgd_config: sgd_lib.SGDConfig,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, DATA_AXIS), P()),
-        out_specs=(P(), P()),
+        in_specs=(st_specs, P(), P(), P(None, DATA_AXIS), P()),
+        out_specs=(st_specs, P()),
+        **extra,
     )
     rep = replicated_sharding(mesh)
-    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
+    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(st_sh, rep))
 
 
 def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
                            lr_schedule: Callable[[jax.Array], jax.Array],
                            mesh: Mesh, compute_dtype=None,
                            device_augment: bool = False,
-                           sync_bn: bool = False):
+                           sync_bn: bool = False, plan=None):
     """Scan-per-epoch training WITH gradient accumulation: ``--resident``
     composed with ``--grad_accum``.
 
@@ -103,8 +109,8 @@ def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
     calls with their own ``[1, A', B']`` shapes; each distinct shape
     compiles once.
     """
-    core = make_loss_and_grads(model, compute_dtype=compute_dtype,
-                               sync_bn=sync_bn)
+    core, st_specs, st_sh, extra = make_step_wiring(
+        model, mesh, compute_dtype, sync_bn, plan)
     update = make_group_update(sgd_config, lr_schedule)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
@@ -126,14 +132,15 @@ def make_train_epoch_accum(model, sgd_config: sgd_lib.SGDConfig,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(None, None, DATA_AXIS), P()),
-        out_specs=(P(), P()),
+        in_specs=(st_specs, P(), P(), P(None, None, DATA_AXIS), P()),
+        out_specs=(st_specs, P()),
+        **extra,
     )
     rep = replicated_sharding(mesh)
-    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
+    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(st_sh, rep))
 
 
-def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
+def make_eval_epoch(model, mesh: Mesh, compute_dtype=None, plan=None):
     """Whole-test-set evaluation as one jitted scan: global (correct, total).
 
     The scan analogue of :func:`~ddp_tpu.train.step.make_eval_step` — same
@@ -142,10 +149,15 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
     the compiled program: ``eval_fn(params, batch_stats, images, labels,
     idx, mask) -> (correct, total)`` with ``idx``/``mask`` of shape
     ``[steps, global_batch]`` (indices padded to shape; ``mask`` zeroes the
-    padding rows out of both counters).
+    padding rows out of both counters).  ``plan`` (tp) shards the params
+    over ``model``; the counters reduce over ``data`` only.
     """
-
-    apply_fn = make_eval_apply(model, compute_dtype)
+    if plan is None:
+        p_specs, s_specs, tp_axis, extra = P(), P(), None, {}
+    else:
+        p_specs, s_specs = plan.param_specs, plan.stats_specs
+        tp_axis, extra = MODEL_AXIS, {"check_vma": False}
+    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis)
 
     def _shard_body(params, batch_stats, images, labels, idx, mask):
         from ..ops.gather import gather_rows
@@ -171,9 +183,10 @@ def make_eval_epoch(model, mesh: Mesh, compute_dtype=None):
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(None, DATA_AXIS),
+        in_specs=(p_specs, s_specs, P(), P(), P(None, DATA_AXIS),
                   P(None, DATA_AXIS)),
         out_specs=(P(), P()),
+        **extra,
     )
     rep = replicated_sharding(mesh)
     return jax.jit(mapped, out_shardings=(rep, rep))

@@ -33,24 +33,29 @@ _step_cache: dict = {}
 
 def evaluate(model, params, batch_stats, loader, mesh, *,
              compute_dtype=None, progress: bool = True,
-             tracer=None) -> float:
+             tracer=None, plan=None) -> float:
     """Accuracy in percent, as a Python float (reference singlegpu.py:205).
     Records one ``eval`` span covering the full test-set pass (``tracer``
-    defaults to the process tracer cli.run installs)."""
+    defaults to the process tracer cli.run installs).  ``plan`` (tp) runs
+    the tensor-parallel eval forward — params must be sharded per the
+    plan's specs."""
     tracer = tracer if tracer is not None else get_tracer()
     with tracer.span("eval"):
         return _evaluate_body(model, params, batch_stats, loader, mesh,
                               compute_dtype=compute_dtype,
-                              progress=progress)
+                              progress=progress, plan=plan)
 
 
 def _evaluate_body(model, params, batch_stats, loader, mesh, *,
-                   compute_dtype=None, progress: bool = True) -> float:
-    key = (model, mesh, compute_dtype)  # ModelDef is a hashable NamedTuple
+                   compute_dtype=None, progress: bool = True,
+                   plan=None) -> float:
+    # ModelDef is a hashable NamedTuple; the plan derives from
+    # (model, mesh), so its presence-bit completes the key.
+    key = (model, mesh, compute_dtype, plan is not None)
     eval_step = _step_cache.get(key)
     if eval_step is None:
         eval_step = _step_cache[key] = make_eval_step(
-            model, mesh, compute_dtype=compute_dtype)
+            model, mesh, compute_dtype=compute_dtype, plan=plan)
     # Per-batch counters stay ON DEVICE until the loop ends: a float(c)
     # inside the loop costs one blocking host read per batch — one full
     # link round trip each on remote-device setups — and serializes the
@@ -80,7 +85,7 @@ _epoch_cache: dict = {}
 
 
 def evaluate_resident(model, params, batch_stats, resident, loader, mesh, *,
-                      compute_dtype=None, tracer=None) -> float:
+                      compute_dtype=None, tracer=None, plan=None) -> float:
     """Accuracy (%) over a device-resident test set, as ONE jitted scan.
 
     Same result as :func:`evaluate` (same masked ``psum`` counters —
@@ -90,11 +95,11 @@ def evaluate_resident(model, params, batch_stats, resident, loader, mesh, *,
     """
     from .epoch import make_eval_epoch, put_index_matrix
 
-    key = (model, mesh, compute_dtype)
+    key = (model, mesh, compute_dtype, plan is not None)
     eval_epoch = _epoch_cache.get(key)
     if eval_epoch is None:
         eval_epoch = _epoch_cache[key] = make_eval_epoch(
-            model, mesh, compute_dtype=compute_dtype)
+            model, mesh, compute_dtype=compute_dtype, plan=plan)
     tracer = tracer if tracer is not None else get_tracer()
     with tracer.span("eval"):
         idx, mask = loader.epoch_index_matrix()

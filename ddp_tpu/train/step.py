@@ -38,8 +38,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
-from ..parallel.mesh import (DATA_AXIS, assemble_from_local, batch_sharding,
-                             scan_unroll,
+from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, assemble_from_local,
+                             batch_sharding, data_axis_size, scan_unroll,
                              replicated_sharding)
 from ..utils.compat import vma_semantics
 
@@ -124,6 +124,40 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
                 lambda g: lax.pmean(g, DATA_AXIS), grads)
         new_stats = jax.tree_util.tree_map(
             lambda s: lax.pmean(s, DATA_AXIS), new_stats)
+        return loss, new_stats, grads
+
+    return loss_and_grads
+
+
+def make_loss_and_grads_tp(model, data_size: int, compute_dtype=None,
+                           sync_bn: bool = False):
+    """The tensor-parallel replicated-update gradient core: same signature
+    and contract as :func:`make_loss_and_grads`, for a 2-D (data × model)
+    mesh with params sharded per the tp plan (parallel/tp/plan.py).
+
+    Built zero-style rather than by differentiating the psum'd loss: the
+    per-shard backward differentiates the collective-free LOCAL objective
+    ``ce_sum/(count*d)`` (train/zero.py:_make_local_grads, here with the
+    model's ``tp_axis`` forward — whose only collectives, the row-parallel
+    psums, carry identity transposes), then the grads are EXPLICITLY
+    ``psum``-ed over ``data`` only.  The sum of the local objectives over
+    the d data shards is the global-mean loss, so that psum IS the DDP
+    all-reduce — and because no collective is ever differentiated, the
+    core behaves identically under the vma and legacy transpose regimes
+    (the subtlety :func:`make_loss_and_grads`'s two branches exist for).
+    Model-sharded leaves get their own slice's gradient (their data-axis
+    replicas agree; no ``model``-axis gradient collective exists — axis
+    correctness is the whole game, tests/test_tp.py pins it bitwise at
+    m=1)."""
+    from .zero import _make_local_grads
+    local_grads = _make_local_grads(model, data_size, compute_dtype,
+                                    sync_bn, tp_axis=MODEL_AXIS)
+
+    def loss_and_grads(params, batch_stats, images, labels, rng):
+        loss, new_stats, grads = local_grads(params, batch_stats, images,
+                                             labels, rng)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, DATA_AXIS), grads)
         return loss, new_stats, grads
 
     return loss_and_grads
@@ -261,10 +295,32 @@ def make_group_step(group_grads, update):
     return group_step
 
 
+def make_step_wiring(model, mesh: Mesh, compute_dtype, sync_bn, plan):
+    """``(loss core, state specs, state shardings, extra shard_map
+    kwargs)`` for a step/epoch builder — the tp delta in one place,
+    shared by both step builders here and the epoch builders
+    (train/epoch.py).  The batch specs are UNCHANGED either way (split on
+    ``data``, replicated over ``model``); with a plan the state specs
+    follow its per-leaf PartitionSpecs and ``check_vma=False`` because
+    the TP program's collectives are all explicit with their own
+    transposes (the same regime train/zero.py documents)."""
+    if plan is None:
+        core = make_loss_and_grads(model, compute_dtype=compute_dtype,
+                                   sync_bn=sync_bn)
+        return core, P(), replicated_sharding(mesh), {}
+    from ..parallel.tp.plan import state_shardings, state_specs
+    core = make_loss_and_grads_tp(model, data_axis_size(mesh),
+                                  compute_dtype=compute_dtype,
+                                  sync_bn=sync_bn)
+    return (core, state_specs(plan), state_shardings(plan, mesh),
+            {"check_vma": False})
+
+
 def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
                     lr_schedule: Callable[[jax.Array], jax.Array],
                     mesh: Mesh, compute_dtype=None,
-                    device_augment: bool = False, sync_bn: bool = False):
+                    device_augment: bool = False, sync_bn: bool = False,
+                    plan=None):
     """Build the jitted SPMD train step for ``model`` over ``mesh``.
 
     Returns ``step_fn(state, batch, rng) -> (state, loss)`` where ``batch``
@@ -274,29 +330,35 @@ def make_train_step(model, sgd_config: sgd_lib.SGDConfig,
     on-device RandomCrop+HFlip (data/device_augment.py) — in that mode the
     loader must be built with ``augment=False``.  ``sync_bn=True`` syncs
     BN statistics across shards (multigpu.py:127's commented-out option).
+
+    ``plan`` (a :class:`~ddp_tpu.parallel.tp.plan.TPPlan`, 2-D mesh) runs
+    the tensor-parallel variant: params/momentum sharded per the plan's
+    specs over ``model``, batch still split over ``data`` only, gradients
+    reduced over ``data`` only (:func:`make_loss_and_grads_tp`); the state
+    must be ``device_put`` onto ``state_shardings(plan, mesh)``.
     """
+    core, st_specs, st_sh, extra = make_step_wiring(
+        model, mesh, compute_dtype, sync_bn, plan)
     _shard_body = make_group_step(
-        make_single_micro(
-            make_loss_and_grads(model, compute_dtype=compute_dtype,
-                                sync_bn=sync_bn),
-            _micro_from_batch(device_augment)),
+        make_single_micro(core, _micro_from_batch(device_augment)),
         make_group_update(sgd_config, lr_schedule))
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
-        out_specs=(P(), P()),
+        in_specs=(st_specs,
+                  {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
+        out_specs=(st_specs, P()),
+        **extra,
     )
-    rep = replicated_sharding(mesh)
     return jax.jit(mapped, donate_argnums=(0,),
-                   out_shardings=(rep, rep))
+                   out_shardings=(st_sh, replicated_sharding(mesh)))
 
 
 def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
                           lr_schedule: Callable[[jax.Array], jax.Array],
                           mesh: Mesh, compute_dtype=None,
                           device_augment: bool = False,
-                          sync_bn: bool = False):
+                          sync_bn: bool = False, plan=None):
     """Gradient accumulation: one optimizer step over A stacked
     micro-batches (torch's no_sync()+step-every-A, TPU-shaped).
 
@@ -309,10 +371,13 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
     exactly like torch under accumulation); ONE SGD update at lr(step)
     follows.  Distinct A values (a ragged tail group) compile once each.
     ``loss`` is the mean of the micro-batch global-mean losses.
+    ``plan`` runs the tensor-parallel variant (see
+    :func:`make_train_step`); the accumulation scaffold is the shared one
+    either way, so the semantics cannot drift.
     """
-    accum = make_accum_scan(make_loss_and_grads(
-        model, compute_dtype=compute_dtype, sync_bn=sync_bn),
-        unroll_fn=lambda n: scan_unroll(mesh, n))
+    core, st_specs, st_sh, extra = make_step_wiring(
+        model, mesh, compute_dtype, sync_bn, plan)
+    accum = make_accum_scan(core, unroll_fn=lambda n: scan_unroll(mesh, n))
     get_micro = _micro_from_batch(device_augment)
     _shard_body = make_group_step(
         lambda p, s, xs, rng: accum(p, s, xs, get_micro, rng),
@@ -320,15 +385,16 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), {"image": P(None, DATA_AXIS),
-                        "label": P(None, DATA_AXIS)}, P()),
-        out_specs=(P(), P()),
+        in_specs=(st_specs, {"image": P(None, DATA_AXIS),
+                             "label": P(None, DATA_AXIS)}, P()),
+        out_specs=(st_specs, P()),
+        **extra,
     )
-    rep = replicated_sharding(mesh)
-    return jax.jit(mapped, donate_argnums=(0,), out_shardings=(rep, rep))
+    return jax.jit(mapped, donate_argnums=(0,),
+                   out_shardings=(st_sh, replicated_sharding(mesh)))
 
 
-def make_eval_apply(model, compute_dtype=None):
+def make_eval_apply(model, compute_dtype=None, tp_axis=None):
     """The per-shard eval-mode forward — ``fn(params, batch_stats, images)
     -> logits`` with BN in running-stats mode (``model.eval()`` semantics,
     singlegpu.py:189) and the on-device uint8 ToTensor scaling.
@@ -337,19 +403,23 @@ def make_eval_apply(model, compute_dtype=None):
     (training-loop evaluation) and :func:`make_eval_forward` (the serving
     engine's logits program, ddp_tpu/serve/) both trace exactly this
     function, so served predictions cannot drift from ``evaluate()``.
+    ``tp_axis`` threads the tensor-parallel forward through (model-sharded
+    params, row-parallel psums over that axis — parallel/tp/).
     """
 
     def apply_fn(params, batch_stats, images):
         logits, _ = model.apply(params, batch_stats,
                                 _as_input(images, compute_dtype),
-                                train=False, compute_dtype=compute_dtype)
+                                train=False, compute_dtype=compute_dtype,
+                                **({} if tp_axis is None
+                                   else {"tp_axis": tp_axis}))
         return logits
 
     return apply_fn
 
 
 def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
-                      on_trace: Callable[[], None] = None):
+                      on_trace: Callable[[], None] = None, plan=None):
     """Jitted sharded eval forward returning the LOGITS themselves:
     ``forward(params, batch_stats, images[B,H,W,C]) -> logits[B,C]`` with
     the batch sharded on ``data`` and per-row results gathered — the
@@ -368,8 +438,17 @@ def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
     may still pick a differently-rounded kernel strategy for a much
     larger per-shard batch shape, so bit-for-bit comparisons must compare
     matching bucket shapes (the contract tests/test_serve.py pins).
+
+    ``plan`` (tp) shards the params over ``model``; the logits come out
+    sharded on ``data`` exactly as in the 1-D case (each model shard holds
+    the full post-psum logits for its data rows).
     """
-    apply_fn = make_eval_apply(model, compute_dtype)
+    if plan is None:
+        p_specs, s_specs, tp_axis, extra = P(), P(), None, {}
+    else:
+        p_specs, s_specs = plan.param_specs, plan.stats_specs
+        tp_axis, extra = MODEL_AXIS, {"check_vma": False}
+    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis)
 
     def _shard_body(params, batch_stats, images):
         if on_trace is not None:
@@ -378,14 +457,15 @@ def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), P(), P(DATA_AXIS)),
+        in_specs=(p_specs, s_specs, P(DATA_AXIS)),
         out_specs=P(DATA_AXIS),
+        **extra,
     )
     return jax.jit(mapped,
                    out_shardings=NamedSharding(mesh, P(DATA_AXIS)))
 
 
-def make_eval_step(model, mesh: Mesh, compute_dtype=None):
+def make_eval_step(model, mesh: Mesh, compute_dtype=None, plan=None):
     """Sharded evaluation step: global (correct, total) via ``psum``.
 
     The reference redundantly evaluates the full test set on every rank
@@ -393,9 +473,16 @@ def make_eval_step(model, mesh: Mesh, compute_dtype=None):
     the counters are summed over ICI — same result, 1/N the work.  ``mask``
     zeroes the padding rows that keep shapes static (test set size need not
     divide the mesh).  The forward is :func:`make_eval_apply` — the same
-    function the serving engine's logits program traces.
+    function the serving engine's logits program traces.  ``plan`` (tp)
+    shards the params over ``model``; the counters still reduce over
+    ``data`` only (every model shard computes the same post-psum logits).
     """
-    apply_fn = make_eval_apply(model, compute_dtype)
+    if plan is None:
+        p_specs, s_specs, tp_axis, extra = P(), P(), None, {}
+    else:
+        p_specs, s_specs = plan.param_specs, plan.stats_specs
+        tp_axis, extra = MODEL_AXIS, {"check_vma": False}
+    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis)
 
     def _shard_body(params, batch_stats, batch):
         logits = apply_fn(params, batch_stats, batch["image"])
@@ -407,9 +494,11 @@ def make_eval_step(model, mesh: Mesh, compute_dtype=None):
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(P(), P(), {"image": P(DATA_AXIS), "label": P(DATA_AXIS),
-                             "mask": P(DATA_AXIS)}),
+        in_specs=(p_specs, s_specs,
+                  {"image": P(DATA_AXIS), "label": P(DATA_AXIS),
+                   "mask": P(DATA_AXIS)}),
         out_specs=(P(), P()),
+        **extra,
     )
     rep = replicated_sharding(mesh)
     return jax.jit(mapped, out_shardings=(rep, rep))

@@ -43,6 +43,7 @@ import numpy as np
 from ..obs.tracer import get_tracer
 from ..optim.sgd import SGDConfig, SGDState
 from ..parallel import dist
+from ..parallel.mesh import replicated_sharding
 from ..utils.metrics import MetricsLogger
 from .checkpoint import save_checkpoint
 from .step import TrainState, init_train_state, make_train_step
@@ -93,7 +94,8 @@ class Trainer:
                  prefetch_workers: int = 4,
                  prefetch_stats=None,
                  tracer=None,
-                 live=None):
+                 live=None,
+                 tp_plan=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -157,6 +159,17 @@ class Trainer:
         self._history_base = self._host_step
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
+        # Tensor parallelism (parallel/tp/): a TPPlan on a 2-D (data x
+        # model) mesh.  The state — fresh init or a canonical (replicated)
+        # checkpoint restore — is re-sharded onto the plan's per-leaf
+        # specs here, which is also what makes checkpoints PORTABLE across
+        # mesh shapes: the file stays canonical (save gathers, below) and
+        # restore re-shards onto whatever mesh this run has.
+        self.tp_plan = tp_plan
+        if tp_plan is not None:
+            from ..parallel.tp.plan import state_shardings
+            self.state = jax.device_put(self.state,
+                                        state_shardings(tp_plan, mesh))
         # Streaming overlap engine knobs (data/prefetch.py): how many
         # batches may be in flight beyond the worker pool's hands, and how
         # many materialise/augment workers run.  depth=0 disables the
@@ -174,17 +187,19 @@ class Trainer:
         self._live = live if self.gpu_id == 0 else None
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
-            # lives as one flat array sharded over ``data`` (1/R per chip).
+            # lives as one flat array sharded over ``data`` (1/R per chip;
+            # [m, L] over P(model, data) when composed with a tp_plan).
             # Checkpoints stay in the canonical per-leaf format either way.
             from .zero import init_opt_shard, pytree_to_opt_shard
             opt = (pytree_to_opt_shard(self.state.opt_state.momentum_buf,
-                                       mesh)
-                   if self.start_epoch else init_opt_shard(params, mesh))
+                                       mesh, plan=tp_plan)
+                   if self.start_epoch
+                   else init_opt_shard(params, mesh, plan=tp_plan))
             self.state = TrainState(self.state.params, self.state.batch_stats,
                                     opt, self.state.step)
         self.resident = None
         kw = dict(compute_dtype=compute_dtype, device_augment=device_augment,
-                  sync_bn=sync_bn)
+                  sync_bn=sync_bn, plan=tp_plan)
         if resident:
             # Device-resident path: dataset uploaded once, whole epoch as a
             # single jitted lax.scan (train/epoch.py) — zero per-step host
@@ -440,7 +455,23 @@ class Trainer:
         if self.shard_update:
             from .zero import opt_shard_to_pytree
             opt_state = opt_shard_to_pytree(self.state.params, opt_state,
-                                            self.mesh)
+                                            self.mesh, plan=self.tp_plan)
+        # Tensor parallelism: SAVE GATHERS — the model-sharded leaves are
+        # resharded to replicated (an all-gather over the ``model`` axis;
+        # collective under multi-host, so it sits BEFORE the rank-0 gate
+        # like the zero conversion above), keeping the file in the one
+        # canonical format every mesh shape can restore (the portability
+        # contract tests/test_tp.py and the 1-D serve path rely on).
+        params, stats = self.state.params, self.state.batch_stats
+        gathered = False
+        if self.tp_plan is not None:
+            rep = replicated_sharding(self.mesh)
+            params, stats, mom = jax.jit(
+                lambda p, s, m: (p, s, m),
+                out_shardings=(rep, rep, rep))(params, stats,
+                                               opt_state.momentum_buf)
+            opt_state = SGDState(mom)
+            gathered = True
         if self.gpu_id != 0:  # reference rank-0 gate, multigpu.py:118
             return
         # Async write: snapshot the state into FRESH device buffers (an
@@ -453,12 +484,14 @@ class Trainer:
         # _join_pending_save above guarantees at most one writer and that
         # overwrites of the fixed path happen in epoch order.
         self._join_pending_save()
-        snap_params, snap_stats = jax.tree_util.tree_map(
-            jnp.copy, (self.state.params, self.state.batch_stats))
-        # Zero mode: opt_shard_to_pytree's output is already fresh device
-        # arrays (all-gathered, never part of the donated train state) —
-        # copying them again would round-trip ~25 MB for nothing.
-        snap_opt = (opt_state.momentum_buf if self.shard_update
+        # TP mode: the gather above already produced fresh replicated
+        # arrays (never part of the donated train state) — like the zero
+        # conversion's output, copying them again would be pure waste.
+        snap_params, snap_stats = (
+            (params, stats) if gathered
+            else jax.tree_util.tree_map(jnp.copy, (params, stats)))
+        snap_opt = (opt_state.momentum_buf
+                    if self.shard_update or gathered
                     else jax.tree_util.tree_map(jnp.copy,
                                                 opt_state.momentum_buf))
         for leaf in jax.tree_util.tree_leaves(
@@ -516,11 +549,16 @@ class Trainer:
             jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
             jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
             jnp.asarray(ckpt.step, jnp.int32))
+        if self.tp_plan is not None:
+            from ..parallel.tp.plan import state_shardings
+            state = jax.device_put(state,
+                                   state_shardings(self.tp_plan, self.mesh))
         if self.shard_update:
             from .zero import pytree_to_opt_shard
             state = TrainState(state.params, state.batch_stats,
                                pytree_to_opt_shard(
-                                   state.opt_state.momentum_buf, self.mesh),
+                                   state.opt_state.momentum_buf, self.mesh,
+                                   plan=self.tp_plan),
                                state.step)
         self.state = state
         self._host_step = int(ckpt.step)

@@ -54,7 +54,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..optim import sgd as sgd_lib
 from ..ops.losses import cross_entropy_sum_count
-from ..parallel.mesh import DATA_AXIS, replicated_sharding, scan_unroll
+from ..parallel.mesh import (DATA_AXIS, MODEL_AXIS, data_axis_size,
+                             replicated_sharding, scan_unroll)
 from .step import (TrainState, _as_input, _micro_from_batch,
                    make_accum_scan, make_group_step, make_single_micro,
                    micro_from_table)
@@ -76,15 +77,32 @@ def _put_flat_sharded(flat_np: np.ndarray, mesh: Mesh) -> jax.Array:
                                         lambda idx: flat_np[idx])
 
 
-def init_opt_shard(params, mesh: Mesh) -> sgd_lib.SGDState:
+def init_opt_shard(params, mesh: Mesh, plan=None) -> sgd_lib.SGDState:
     """Momentum as ONE flat global array sharded over ``data`` — each chip
-    holds 1/R of it (vs. a full replica in the plain path)."""
-    n_pad = padded_size(params, mesh.devices.size)
-    return sgd_lib.SGDState(
-        _put_flat_sharded(np.zeros(n_pad, np.float32), mesh))
+    holds 1/R of it (vs. a full replica in the plain path).
+
+    With a tp ``plan`` (2-D mesh) the buffer is ``[m, L]`` sharded
+    ``P(model, data)`` — the spec-merge of params-along-``model`` with
+    update-along-``data``: row j is model shard j's flat local parameter
+    vector (its slices of the sharded leaves plus the replicated leaves),
+    of which each data shard owns 1/d.  Each chip then holds
+    ``local_params/d`` momentum — BOTH savings compose."""
+    if plan is None:
+        n_pad = padded_size(params, mesh.devices.size)
+        return sgd_lib.SGDState(
+            _put_flat_sharded(np.zeros(n_pad, np.float32), mesh))
+    from ..parallel.tp.plan import local_param_count
+    d = data_axis_size(mesh)
+    n = local_param_count(plan)
+    n_pad = n + (-n) % d
+    sharding = NamedSharding(mesh, P(MODEL_AXIS, DATA_AXIS))
+    zeros = np.zeros((plan.model_size, n_pad), np.float32)
+    return sgd_lib.SGDState(jax.make_array_from_callback(
+        zeros.shape, sharding, lambda idx: zeros[idx]))
 
 
-def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh):
+def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh,
+                        plan=None):
     """Sharded flat momentum -> the canonical per-leaf pytree (checkpoint
     format stays identical across modes, so snapshots are interchangeable).
 
@@ -95,7 +113,27 @@ def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh):
     replicated arrays, async-dispatched): the caller can hand the result
     to the async checkpoint writer without this function having blocked
     the training loop on a device->host read.
+
+    With a tp ``plan`` the ``[m, L]`` buffer unravels through a shard_map
+    (each model shard's row is ITS local parameter layout), emerging as a
+    plan-sharded per-leaf pytree; the Trainer's checkpoint gather then
+    replicates it along with the params (one collective path for all
+    leaves).
     """
+    if plan is not None:
+        p_specs = plan.param_specs
+
+        def body(p, buf):
+            flat, unravel = ravel_pytree(p)
+            full = lax.all_gather(buf[0], DATA_AXIS, axis=0, tiled=True)
+            return unravel(full[:flat.shape[0]])
+
+        mapped = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(p_specs, P(MODEL_AXIS, DATA_AXIS)),
+            out_specs=p_specs, check_vma=False)
+        return sgd_lib.SGDState(jax.jit(mapped)(params,
+                                                opt_state.momentum_buf))
     flat, unravel = ravel_pytree(params)
     n = flat.shape[0]
     # The truncating slice AND the unravel reshapes run INSIDE the jit:
@@ -108,8 +146,35 @@ def opt_shard_to_pytree(params, opt_state: sgd_lib.SGDState, mesh: Mesh):
     return sgd_lib.SGDState(tree)
 
 
-def pytree_to_opt_shard(momentum_pytree, mesh: Mesh) -> sgd_lib.SGDState:
-    """Canonical momentum pytree -> sharded flat buffer (resume path)."""
+def pytree_to_opt_shard(momentum_pytree, mesh: Mesh,
+                        plan=None) -> sgd_lib.SGDState:
+    """Canonical momentum pytree -> sharded flat buffer (resume path).
+    With a tp ``plan``: canonical (replicated, host or device) pytree ->
+    the ``[m, L]`` ``P(model, data)`` buffer, via a shard_map in which
+    each device ravels its model shard's leaf slices and keeps its own
+    1/d block — the exact inverse of :func:`opt_shard_to_pytree`'s tp
+    path (round-trip pinned in tests/test_tp.py)."""
+    if plan is not None:
+        from ..parallel.tp.plan import local_param_count, state_shardings
+        d = data_axis_size(mesh)
+        n = local_param_count(plan)
+        n_pad = n + (-n) % d
+        sharded_tree = jax.device_put(
+            jax.tree_util.tree_map(jnp.asarray, momentum_pytree),
+            state_shardings(plan, mesh).params)
+
+        def body(tree):
+            flat, _ = ravel_pytree(tree)
+            padded = jnp.pad(flat, (0, n_pad - flat.shape[0]))
+            block = lax.dynamic_slice(
+                padded, (lax.axis_index(DATA_AXIS) * (n_pad // d),),
+                (n_pad // d,))
+            return block[None]
+
+        mapped = jax.shard_map(
+            body, mesh=mesh, in_specs=(plan.param_specs,),
+            out_specs=P(MODEL_AXIS, DATA_AXIS), check_vma=False)
+        return sgd_lib.SGDState(jax.jit(mapped)(sharded_tree))
     flat, _ = ravel_pytree(momentum_pytree)
     n_pad = padded_size(momentum_pytree, mesh.devices.size)
     flat_np = np.zeros(n_pad, np.float32)
@@ -118,7 +183,7 @@ def pytree_to_opt_shard(momentum_pytree, mesh: Mesh) -> sgd_lib.SGDState:
 
 
 def _make_local_grads(model, R: int, compute_dtype=None,
-                      sync_bn: bool = False):
+                      sync_bn: bool = False, tp_axis=None):
     """Per-shard forward/backward of the collective-free LOCAL objective
     ``ce_sum/(count*R)``: its sum over the R shards is the global-mean loss
     (equal per-shard counts — the sampler padding guarantee,
@@ -129,6 +194,17 @@ def _make_local_grads(model, R: int, compute_dtype=None,
     :func:`~ddp_tpu.train.step.make_loss_and_grads`, so the two cores are
     interchangeable under :func:`~ddp_tpu.train.step.make_accum_scan`;
     ``loss`` is the psum'd global mean and ``stats`` pmean'd.
+
+    ``tp_axis`` (tensor parallelism): R stays the DATA-axis shard count —
+    the model-axis devices in one data row consume the same rows and the
+    local objective must still sum to the global mean over ``data`` alone.
+    "Collective-free" then means free of collectives whose transposes
+    produce cross-shard cotangents: the tp forward's row-parallel psums
+    over ``tp_axis`` carry identity transposes
+    (parallel/tp/layers.py:psum_keepgrad), so the backward stays local per
+    (data, model) device.  This core is shared by the sharded-update path
+    here AND the replicated-update tp core
+    (:func:`~ddp_tpu.train.step.make_loss_and_grads_tp`).
     """
 
     def local_grads(params, batch_stats, images, labels, rng):
@@ -137,7 +213,8 @@ def _make_local_grads(model, R: int, compute_dtype=None,
             with bn_sync_axis(DATA_AXIS if sync_bn else None):
                 logits, new_stats = model.apply(
                     params, batch_stats, _as_input(images, compute_dtype),
-                    train=True, rng=rng, compute_dtype=compute_dtype)
+                    train=True, rng=rng, compute_dtype=compute_dtype,
+                    **({} if tp_axis is None else {"tp_axis": tp_axis}))
             ce_sum, count = cross_entropy_sum_count(logits, labels)
             return ce_sum / (count * R), (new_stats, ce_sum, count)
 
@@ -152,9 +229,16 @@ def _make_local_grads(model, R: int, compute_dtype=None,
 
 
 def _make_zero_update(sgd_config: sgd_lib.SGDConfig,
-                      lr_schedule: Callable[[jax.Array], jax.Array], R: int):
+                      lr_schedule: Callable[[jax.Array], jax.Array], R: int,
+                      tp: bool = False):
     """The sharded update stage: local grads -> psum_scatter -> torch-SGD on
     the 1/R slice -> all_gather.  ``fn(state, grads, new_stats) -> state``.
+
+    ``tp=True``: R is the DATA-axis size, params/grads are this model
+    shard's local slices, and the momentum block carries the ``[1, L/d]``
+    shape of the ``P(model, data)`` buffer — everything else (the flat
+    ravel, the data-axis collectives, the torch SGD convention) is
+    IDENTICAL, which is why the two modes compose rather than multiply.
     """
     mu, wd = sgd_config.momentum, sgd_config.weight_decay
 
@@ -169,103 +253,131 @@ def _make_zero_update(sgd_config: sgd_lib.SGDConfig,
         p_shard = lax.dynamic_slice(
             jnp.pad(flat_p, (0, n_pad - n)),
             (lax.axis_index(DATA_AXIS) * (n_pad // R),), (n_pad // R,))
+        mom = state.opt_state.momentum_buf
+        if tp:
+            mom = mom[0]
         # Torch SGD convention on the slice (optim/sgd.py): wd folded into
         # the gradient before the momentum trace, no decoupling.
-        buf = mu * state.opt_state.momentum_buf + g_shard + wd * p_shard
+        buf = mu * mom + g_shard + wd * p_shard
         lr_t = lr_schedule(state.step)
         new_p_shard = p_shard - lr_t * buf
         flat_new = lax.all_gather(new_p_shard, DATA_AXIS, axis=0, tiled=True)
         params = unravel(flat_new[:n])
-        return TrainState(params, new_stats, sgd_lib.SGDState(buf),
+        return TrainState(params, new_stats,
+                          sgd_lib.SGDState(buf[None] if tp else buf),
                           state.step + 1)
 
     return zero_update
 
 
-def _zero_state_specs() -> TrainState:
+def _zero_state_specs(plan=None) -> TrainState:
+    if plan is not None:
+        from ..parallel.tp.plan import state_specs
+        return state_specs(plan, zero=True)
     return TrainState(params=P(), batch_stats=P(),
                       opt_state=sgd_lib.SGDState(P(DATA_AXIS)), step=P())
 
 
-def _zero_jit(mapped, mesh: Mesh):
+def _zero_jit(mapped, mesh: Mesh, plan=None):
     rep = replicated_sharding(mesh)
-    state_shardings = TrainState(
+    if plan is not None:
+        from ..parallel.tp.plan import state_shardings
+        return jax.jit(mapped, donate_argnums=(0,),
+                       out_shardings=(state_shardings(plan, mesh,
+                                                      zero=True), rep))
+    state_shardings_ = TrainState(
         params=rep, batch_stats=rep,
         opt_state=sgd_lib.SGDState(NamedSharding(mesh, P(DATA_AXIS))),
         step=rep)
     return jax.jit(mapped, donate_argnums=(0,),
-                   out_shardings=(state_shardings, rep))
+                   out_shardings=(state_shardings_, rep))
+
+
+def _zero_pieces(model, mesh: Mesh, sgd_config, lr_schedule, compute_dtype,
+                 sync_bn, plan):
+    """(R, local_grads, zero_update) for the four builders below — R and
+    the tp threading decided in ONE place: the data-axis size and the
+    model's ``tp_axis`` forward under a plan, the flat-mesh size and the
+    plain forward without."""
+    if plan is None:
+        R = mesh.devices.size
+        local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
+        return R, local_grads, _make_zero_update(sgd_config, lr_schedule, R)
+    R = data_axis_size(mesh)
+    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn,
+                                    tp_axis=MODEL_AXIS)
+    return R, local_grads, _make_zero_update(sgd_config, lr_schedule, R,
+                                             tp=True)
 
 
 def make_train_step_zero(model, sgd_config: sgd_lib.SGDConfig,
                          lr_schedule: Callable[[jax.Array], jax.Array],
                          mesh: Mesh, compute_dtype=None,
                          device_augment: bool = False,
-                         sync_bn: bool = False):
+                         sync_bn: bool = False, plan=None):
     """Like :func:`~ddp_tpu.train.step.make_train_step` but with the
     weight update sharded over ``data``.  ``state.opt_state.momentum_buf``
     must come from :func:`init_opt_shard` / :func:`pytree_to_opt_shard`.
+    ``plan`` (tp, 2-D mesh) composes: params along ``model``, the update
+    along ``data`` — pass the plan to the momentum constructors too.
     """
-    R = mesh.devices.size
-    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
-    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+    _R, local_grads, zero_update = _zero_pieces(
+        model, mesh, sgd_config, lr_schedule, compute_dtype, sync_bn, plan)
     _shard_body = make_group_step(
         make_single_micro(local_grads, _micro_from_batch(device_augment)),
         zero_update)
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(_zero_state_specs(),
+        in_specs=(_zero_state_specs(plan),
                   {"image": P(DATA_AXIS), "label": P(DATA_AXIS)}, P()),
-        out_specs=(_zero_state_specs(), P()),
+        out_specs=(_zero_state_specs(plan), P()),
         check_vma=False,
     )
-    return _zero_jit(mapped, mesh)
+    return _zero_jit(mapped, mesh, plan)
 
 
 def make_train_step_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
                                lr_schedule: Callable[[jax.Array], jax.Array],
                                mesh: Mesh, compute_dtype=None,
                                device_augment: bool = False,
-                               sync_bn: bool = False):
+                               sync_bn: bool = False, plan=None):
     """Gradient accumulation with the sharded update: ``batch`` arrays are
     ``[A, B, ...]`` micro-batch stacks (as for
     :func:`~ddp_tpu.train.step.make_train_step_accum`, same RNG fold
     structure); grads are averaged over the inner scan, then ONE
     reduce-scatter + sharded SGD + all-gather."""
-    R = mesh.devices.size
-    accum = make_accum_scan(_make_local_grads(model, R, compute_dtype,
-                                              sync_bn),
+    _R, local_grads, zero_update = _zero_pieces(
+        model, mesh, sgd_config, lr_schedule, compute_dtype, sync_bn, plan)
+    accum = make_accum_scan(local_grads,
                             unroll_fn=lambda n: scan_unroll(mesh, n))
-    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
     get_micro = _micro_from_batch(device_augment)
     _shard_body = make_group_step(
         lambda p, s, xs, rng: accum(p, s, xs, get_micro, rng), zero_update)
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(_zero_state_specs(),
+        in_specs=(_zero_state_specs(plan),
                   {"image": P(None, DATA_AXIS), "label": P(None, DATA_AXIS)},
                   P()),
-        out_specs=(_zero_state_specs(), P()),
+        out_specs=(_zero_state_specs(plan), P()),
         check_vma=False,
     )
-    return _zero_jit(mapped, mesh)
+    return _zero_jit(mapped, mesh, plan)
 
 
 def make_train_epoch_zero(model, sgd_config: sgd_lib.SGDConfig,
                           lr_schedule: Callable[[jax.Array], jax.Array],
                           mesh: Mesh, compute_dtype=None,
                           device_augment: bool = False,
-                          sync_bn: bool = False):
+                          sync_bn: bool = False, plan=None):
     """Device-resident scan-per-epoch with the sharded update:
     ``--resident`` composed with ``--shard_update``.  Same signature as
     :func:`~ddp_tpu.train.epoch.make_train_epoch` (``idx``: int32
     ``[steps, global_batch]``); the RNG fold structure matches the
     streaming zero step, so the two agree step-for-step."""
-    R = mesh.devices.size
-    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
-    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+    _R, local_grads, zero_update = _zero_pieces(
+        model, mesh, sgd_config, lr_schedule, compute_dtype, sync_bn, plan)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         group = make_group_step(
@@ -277,11 +389,12 @@ def make_train_epoch_zero(model, sgd_config: sgd_lib.SGDConfig,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(_zero_state_specs(), P(), P(), P(None, DATA_AXIS), P()),
-        out_specs=(_zero_state_specs(), P()),
+        in_specs=(_zero_state_specs(plan), P(), P(), P(None, DATA_AXIS),
+                  P()),
+        out_specs=(_zero_state_specs(plan), P()),
         check_vma=False,
     )
-    return _zero_jit(mapped, mesh)
+    return _zero_jit(mapped, mesh, plan)
 
 
 def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
@@ -289,14 +402,13 @@ def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
                                                       jax.Array],
                                 mesh: Mesh, compute_dtype=None,
                                 device_augment: bool = False,
-                                sync_bn: bool = False):
+                                sync_bn: bool = False, plan=None):
     """``--resident`` + ``--grad_accum`` + ``--shard_update`` together:
     the grouped epoch scan (``idx``: ``[G, A, global_batch]``, as for
     :func:`~ddp_tpu.train.epoch.make_train_epoch_accum`) with one sharded
     update per group."""
-    R = mesh.devices.size
-    local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
-    zero_update = _make_zero_update(sgd_config, lr_schedule, R)
+    _R, local_grads, zero_update = _zero_pieces(
+        model, mesh, sgd_config, lr_schedule, compute_dtype, sync_bn, plan)
 
     def _shard_body(state: TrainState, images, labels, idx, rng):
         get_micro = micro_from_table(images, labels, device_augment)
@@ -314,9 +426,9 @@ def make_train_epoch_zero_accum(model, sgd_config: sgd_lib.SGDConfig,
 
     mapped = jax.shard_map(
         _shard_body, mesh=mesh,
-        in_specs=(_zero_state_specs(), P(), P(), P(None, None, DATA_AXIS),
-                  P()),
-        out_specs=(_zero_state_specs(), P()),
+        in_specs=(_zero_state_specs(plan), P(), P(),
+                  P(None, None, DATA_AXIS), P()),
+        out_specs=(_zero_state_specs(plan), P()),
         check_vma=False,
     )
-    return _zero_jit(mapped, mesh)
+    return _zero_jit(mapped, mesh, plan)

@@ -164,6 +164,52 @@ def test_checkpoint_from_8dev_training_serves_on_1dev(tmp_path, mesh8):
     np.testing.assert_array_equal(engine.forward(imgs), ref)
 
 
+def test_tp_checkpoint_from_2x4_training_serves_on_1dev(tmp_path):
+    """A snapshot written by a TENSOR-PARALLEL training run on a (2,4)
+    (data x model) mesh — params sharded over ``model``, save gathers to
+    the canonical format — restores into a 1-device serve engine with no
+    conversion step, and the served logits match the tensor-parallel
+    training-side eval forward of the same checkpoint (same predictions;
+    logits within the row-psum contraction-split epsilon — the tp
+    extension of the 8-dev -> 1-dev portability contract above)."""
+    import functools
+    from ddp_tpu.data import TrainLoader
+    from ddp_tpu.optim import SGDConfig, triangular_lr
+    from ddp_tpu.parallel.mesh import make_mesh as mk
+    from ddp_tpu.parallel.tp.plan import plan_for_model, state_shardings
+    from ddp_tpu.resilience.lineage import latest_verifiable
+    from ddp_tpu.train import Trainer
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(1))
+    mesh24 = mk(shape=(2, 4))
+    plan = plan_for_model("deepnn", jax.device_get(params), stats,
+                          model_size=4)
+    train_ds, _ = synthetic(n_train=64, seed=2)
+    loader = TrainLoader(train_ds, 16, 2, augment=True, seed=0)
+    path = str(tmp_path / "tp_ck.pt")
+    trainer = Trainer(
+        model, loader, params, stats, mesh=mesh24,
+        lr_schedule=functools.partial(triangular_lr, base_lr=0.05,
+                                      num_epochs=1, steps_per_epoch=2),
+        sgd_config=SGDConfig(lr=0.05), save_every=1, snapshot_path=path,
+        tp_plan=plan)
+    trainer.train(1)
+
+    engine = ServeEngine.from_checkpoint(path, "deepnn", mesh=make_mesh(1),
+                                         buckets=(32,))
+    assert engine.warm() == 1
+    ckpt, _used = latest_verifiable(path)
+    p_sh = jax.device_put(
+        jax.tree_util.tree_map(np.asarray, ckpt.params),
+        state_shardings(plan, mesh24).params)
+    tp_fwd = make_eval_forward(model, mesh24, plan=plan)
+    imgs = _images(32, seed=4)
+    ref = np.asarray(jax.device_get(tp_fwd(p_sh, ckpt.batch_stats, imgs)))
+    served = engine.forward(imgs)
+    np.testing.assert_allclose(served, ref, atol=1e-5, rtol=0)
+    np.testing.assert_array_equal(served.argmax(-1), ref.argmax(-1))
+
+
 def test_latest_verifiable_accepts_a_directory(tmp_path, deepnn):
     """The serve engine is pointed at 'where checkpoints land' — a
     directory resolves to the manifest's head (or the default
