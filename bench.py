@@ -118,6 +118,27 @@ def _parse_args():
                         "records ms/step + MFU per mesh shape (the "
                         "model-axis cost curve; chip paste in RUNBOOK "
                         "section 10).  Uses --sweep_platform like --sweep")
+    p.add_argument("--ckpt_bench", action="store_true",
+                   help="Checkpoint-path bench (ISSUE 6): save + restore "
+                        "wall time and PEAK HOST RSS for the gathered (v1) "
+                        "vs sharded (v2, train/ckpt_shard.py) formats at "
+                        "each --ckpt_sizes model size.  One child process "
+                        "per (size, format, phase) so ru_maxrss cleanly "
+                        "attributes each phase's peak; saves run on a "
+                        "(2,4) 8-virtual-device mesh, restores reshard "
+                        "onto (2,2)x4 (the elastic-resume path).  Record: "
+                        "BENCH_r08.json; chip paste in RUNBOOK section 11")
+    p.add_argument("--ckpt_sizes", default="32,128", metavar="MB1,MB2,...",
+                   help="--ckpt_bench checkpoint payload sizes in MiB "
+                        "(params + momentum, fp32; default 32,128)")
+    p.add_argument("--ckpt_bench_child", default=None,
+                   choices=["save", "restore"],
+                   help="(internal) --ckpt_bench child phase")
+    p.add_argument("--ckpt_format", default="gathered",
+                   choices=["gathered", "sharded"],
+                   help="(--ckpt_bench child) checkpoint layout under test")
+    p.add_argument("--ckpt_size_mb", default=32, type=int,
+                   help="(--ckpt_bench child) payload size in MiB")
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size (default: all visible devices)")
     p.add_argument("--batch_sweep", default=None, metavar="B1,B2,...",
@@ -248,12 +269,19 @@ def main() -> None:
     args = _parse_args()
     if args.dump_hlo and (args.sweep or args.pipeline or args.e2e
                           or args.batch_sweep or args.stream_attr
-                          or args.serve or args.tp_sweep):
+                          or args.serve or args.tp_sweep
+                          or args.ckpt_bench or args.ckpt_bench_child):
         raise SystemExit("--dump_hlo only applies to the steady-state step "
                          "bench (it dumps the timed step/scan program); it "
                          "has no program to dump in --sweep/--batch_sweep/"
-                         "--pipeline/--e2e/--stream_attr/--serve/--tp_sweep "
-                         "modes")
+                         "--pipeline/--e2e/--stream_attr/--serve/--tp_sweep/"
+                         "--ckpt_bench modes")
+    if args.ckpt_bench_child:
+        _bench_ckpt_child(args)
+        return
+    if args.ckpt_bench:
+        _bench_ckpt(args)
+        return
     if args.serve:
         _bench_serve(args)
         return
@@ -1037,6 +1065,154 @@ def _bench_tp_sweep(args) -> None:
         "unit": f"ms/step ratio, {shapes[0]} vs {shapes[-1]} (data x model)",
         "vs_baseline": 1.0,
         "tp_sweep": per,
+    }))
+
+
+def _ckpt_synth_tree(size_mb: int, *, with_arrays: bool = True):
+    """Synthetic checkpoint pytree of ~``size_mb`` MiB total (params plus
+    a same-sized momentum mirror): alternating column/row model-sharded
+    (1024, 2048) fp32 matrices with replicated biases — the layout the tp
+    planner emits, at a controllable size so the checkpoint path is
+    measured at >= 2 model sizes without needing a model that large.
+    Returns ``(host_tree_or_None, spec_tree)``; extents divide every mesh
+    the bench uses (model axis 4 at save, 2 at restore)."""
+    from jax.sharding import PartitionSpec as P
+    n = max(1, int(size_mb) // 16)  # one 8 MiB matrix each in params+mom
+    host, specs = {}, {}
+    for i in range(n):
+        col = i % 2 == 0
+        specs[f"layer{i}"] = {
+            "w": P(None, "model") if col else P("model", None),
+            "b": P(),
+        }
+        if with_arrays:
+            host[f"layer{i}"] = {
+                "w": np.full((1024, 2048), float(i + 1), np.float32),
+                "b": np.full((2048 if col else 1024,), float(i), np.float32),
+            }
+    return (host if with_arrays else None), specs
+
+
+def _bench_ckpt_child(args) -> None:
+    """One --ckpt_bench measurement in isolation: this process builds the
+    placed (model-sharded) state, runs exactly ONE phase (save | restore)
+    in exactly ONE format, and reports wall time plus ru_maxrss before and
+    after — the peak-RSS delta is attributable to that phase alone."""
+    import resource
+
+    from jax.sharding import NamedSharding
+    from ddp_tpu.optim.sgd import SGDState
+    from ddp_tpu.parallel.mesh import replicated_sharding
+    from ddp_tpu.train.checkpoint import save_checkpoint
+    from ddp_tpu.train.ckpt_shard import (HostBytesProbe, load_for_mesh,
+                                          save_checkpoint_sharded)
+
+    def peak_kb() -> int:
+        return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+
+    d, m = (int(x) for x in (args.mesh_shape or "2,4").split(","))
+    mesh = make_mesh(shape=(d, m))
+    rec = {"value": 0.0, "phase": args.ckpt_bench_child,
+           "format": args.ckpt_format, "size_mb": int(args.ckpt_size_mb),
+           "mesh": f"{d}x{m}"}
+    if args.ckpt_bench_child == "save":
+        host, spec_tree = _ckpt_synth_tree(args.ckpt_size_mb)
+        place = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(a, NamedSharding(mesh, s)),
+            host, spec_tree)
+        mom = jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(np.zeros(a.shape, a.dtype),
+                                        NamedSharding(mesh, s)),
+            host, spec_tree)
+        del host
+        jax.block_until_ready((place, mom))
+        rec["rss_peak_before_kb"] = peak_kb()
+        t0 = time.perf_counter()
+        if args.ckpt_format == "sharded":
+            save_checkpoint_sharded(args.snapshot_path, place, {},
+                                    SGDState(mom), 0, 0, mesh=mesh)
+        else:
+            # The trainer's gathered path: all-gather the model-sharded
+            # leaves to replicated, then the canonical single-file write.
+            rep = replicated_sharding(mesh)
+            g_p = jax.device_put(place, rep)
+            g_m = jax.device_put(mom, rep)
+            jax.block_until_ready((g_p, g_m))
+            save_checkpoint(args.snapshot_path, g_p, {}, SGDState(g_m),
+                            0, 0)
+        rec["wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        rec["rss_peak_after_kb"] = peak_kb()
+    else:
+        _, spec_tree = _ckpt_synth_tree(args.ckpt_size_mb,
+                                        with_arrays=False)
+        probe = HostBytesProbe()
+        rec["rss_peak_before_kb"] = peak_kb()
+        t0 = time.perf_counter()
+        ck = load_for_mesh(args.snapshot_path, mesh,
+                           param_specs=spec_tree, probe=probe)
+        jax.block_until_ready((ck.params, ck.opt_state.momentum_buf))
+        rec["wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        rec["rss_peak_after_kb"] = peak_kb()
+        rec["engine_peak_staging_mb"] = round(probe.peak / 2**20, 2)
+    print(json.dumps(rec))
+
+
+def _bench_ckpt(args) -> None:
+    """Gathered-vs-sharded checkpoint bench (ISSUE 6): per payload size
+    and format, ONE child saves on a (2,4) 8-virtual-device mesh and a
+    SECOND child restores that file resharded onto a (2,2) 4-device mesh
+    (the elastic-resume direction).  Per-child ru_maxrss deltas make the
+    save path's peak host memory a measured number: the gathered save
+    all-gathers the model-sharded leaves (8 replicated device copies plus
+    whole-model npz staging, O(model)); the sharded save streams one
+    model-slot at a time (O(model/m)).  Headline value: gathered-vs-
+    sharded save-path RSS-delta ratio at the LARGEST size (> 1 means the
+    sharded save peaks lower).  Record: BENCH_r08.json."""
+    import tempfile
+
+    from ddp_tpu.utils.platform import cpu_device_env
+    sizes = sorted(int(s) for s in args.ckpt_sizes.split(","))
+    per: dict = {}
+    with tempfile.TemporaryDirectory() as td:
+        for size in sizes:
+            per_size: dict = {}
+            for fmt in ("gathered", "sharded"):
+                path = os.path.join(td, f"ck_{fmt}_{size}.pt")
+                cell: dict = {}
+                for phase, shape, ndev in (("save", "2,4", 8),
+                                           ("restore", "2,2", 4)):
+                    child = [sys.executable, os.path.abspath(__file__),
+                             "--ckpt_bench_child", phase,
+                             "--ckpt_format", fmt,
+                             "--ckpt_size_mb", str(size),
+                             "--mesh_shape", shape,
+                             "--snapshot_path", path]
+                    out = _run_child(child,
+                                     cpu_device_env(ndev, dict(os.environ)),
+                                     f"ckpt bench {fmt} {phase} {size}MB")
+                    delta_mb = round(
+                        (out["rss_peak_after_kb"]
+                         - out["rss_peak_before_kb"]) / 1024, 1)
+                    cell[f"{phase}_ms"] = out["wall_ms"]
+                    cell[f"{phase}_rss_peak_delta_mb"] = delta_mb
+                    if "engine_peak_staging_mb" in out:
+                        cell["restore_engine_peak_staging_mb"] = \
+                            out["engine_peak_staging_mb"]
+                per_size[fmt] = cell
+            per[f"{size}MB"] = per_size
+    big = per[f"{sizes[-1]}MB"]
+    s_delta = big["sharded"]["save_rss_peak_delta_mb"]
+    g_delta = big["gathered"]["save_rss_peak_delta_mb"]
+    print(json.dumps({
+        "metric": f"checkpoint save-path peak host RSS, gathered vs "
+                  f"sharded (sizes {sizes} MiB; save on (2,4)x8 cpu mesh, "
+                  f"restore resharded onto (2,2)x4 — elastic resume)",
+        "value": round(g_delta / max(s_delta, 1.0), 2),
+        "unit": f"gathered/sharded save RSS-delta ratio at {sizes[-1]}MiB "
+                "(> 1: sharded peaks lower; sharded delta floored at "
+                "1 MiB — it can sit below the RSS noise floor)",
+        "vs_baseline": 1.0,
+        "ckpt_bench": per,
     }))
 
 

@@ -192,6 +192,20 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "reference's torch state_dict checkpoint format "
                         "(reference keys for vgg/deepnn, torchvision keys "
                         "for resnet18)")
+    p.add_argument("--ckpt_format", default="gathered",
+                   choices=["gathered", "sharded"],
+                   help="Checkpoint layout (train/ckpt_shard.py): "
+                        "'gathered' = the canonical single-file v1 npz "
+                        "(model-sharded leaves are all-gathered at save "
+                        "time — O(model) host memory and write stream); "
+                        "'sharded' = one shard file per model-axis slot "
+                        "plus a small index, written by per-host parallel "
+                        "writers with no gather — O(model/m) save path.  "
+                        "RESTORE accepts either format on any mesh shape "
+                        "regardless of this flag: --resume redistributes "
+                        "a sharded set onto the live (d', m') mesh "
+                        "shard-by-shard (elastic resume after a "
+                        "pod-shrinking preemption)")
     p.add_argument("--keep_checkpoints", default=1, type=int, metavar="N",
                    help="Retain the newest N checkpoints: the head plus "
                         "N-1 rotated snapshots with a sha-256 manifest "
@@ -693,7 +707,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       prefetch_depth=args.prefetch_depth,
                       prefetch_workers=args.prefetch_workers,
                       prefetch_stats=pstats, tracer=tracer, live=live,
-                      tp_plan=tp_plan)
+                      tp_plan=tp_plan,
+                      ckpt_format=getattr(args, "ckpt_format", "gathered"))
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real

@@ -179,6 +179,27 @@ def state_shardings(plan: TPPlan, mesh: Mesh, *, zero: bool = False):
                       step=sh(P()))
 
 
+def spec_to_json(spec: P) -> list:
+    """A ``PartitionSpec`` as a JSON-serializable entry list — the spec
+    plumbing the sharded-checkpoint manifest records per leaf
+    (train/ckpt_shard.py).  Entries: ``None``, an axis name, or a list of
+    axis names (the general PartitionSpec grammar, even though this
+    codebase's plans only emit single names)."""
+    out: list = []
+    for entry in tuple(spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append(list(entry))
+    return out
+
+
+def spec_from_json(entries) -> P:
+    """Inverse of :func:`spec_to_json`."""
+    return P(*(tuple(e) if isinstance(e, list) else e
+               for e in (entries or [])))
+
+
 def state_specs(plan: TPPlan, *, zero: bool = False):
     """Same tree as :func:`state_shardings` but bare ``PartitionSpec``s —
     the ``shard_map`` in/out_specs form."""

@@ -28,6 +28,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -40,6 +41,14 @@ from ..train.checkpoint import (Checkpoint, CheckpointError, load_checkpoint,
 
 MANIFEST_SUFFIX = ".manifest.json"
 MANIFEST_FORMAT = 1
+
+
+def _entry_shards(entry) -> List[str]:
+    """A manifest entry's shard-file names (empty for gathered v1 heads
+    and malformed entries)."""
+    if not isinstance(entry, dict):
+        return []
+    return [str(s) for s in entry.get("shards", []) if s]
 
 
 def lineage_name(path: str, epoch: int) -> str:
@@ -124,9 +133,18 @@ class CheckpointLineage:
         except Exception:
             return None
 
-    def commit(self, *, epoch: int, step: int, sha256: str) -> None:
+    def commit(self, *, epoch: int, step: int, sha256: str,
+               shards: Optional[List[str]] = None) -> None:
         """Record the just-written head and trim retention to ``keep``
-        states (the head plus ``keep - 1`` rotated snapshots)."""
+        states (the head plus ``keep - 1`` rotated snapshots).
+
+        ``shards`` is the sharded (v2) format's pointer to the head's
+        shard set (train/ckpt_shard.py): the epoch-qualified shard file
+        names the head index references.  Each manifest entry carries its
+        own shard list, and trimming unlinks exactly the shard files that
+        dropped out of the manifest — never one a surviving entry (or the
+        new head) still references, and structurally never an in-flight
+        ``*.tmp`` write."""
         m = read_manifest(self.path) or {}
         retained: List[Dict[str, Any]] = [
             e for e in m.get("retained", []) if isinstance(e, dict)]
@@ -142,15 +160,29 @@ class CheckpointLineage:
         retained = [e for e in retained
                     if e.get("file") not in seen
                     and not seen.add(e.get("file"))]
+        # Shard files referenced BEFORE this commit (old head + every
+        # retained entry, dropped ones included)...
+        old_shards = set(_entry_shards(prev_head))
+        for e in retained:
+            old_shards |= set(_entry_shards(e))
         for dropped in retained[max(self.keep - 1, 0):]:
             self._unlink_rotated(dropped.get("file"))
         retained = retained[:max(self.keep - 1, 0)]
+        head: Dict[str, Any] = {"file": os.path.basename(self.path),
+                                "epoch": int(epoch), "step": int(step),
+                                "sha256": sha256,
+                                "size": os.path.getsize(self.path)}
+        if shards:
+            head["shards"] = [os.path.basename(s) for s in shards]
+        # ...minus the ones still referenced AFTER it = the set to trim.
+        new_shards = set(_entry_shards(head))
+        for e in retained:
+            new_shards |= set(_entry_shards(e))
+        for fname in sorted(old_shards - new_shards):
+            self._unlink_shard(fname)
         manifest = {
             "format": MANIFEST_FORMAT,
-            "head": {"file": os.path.basename(self.path),
-                     "epoch": int(epoch), "step": int(step),
-                     "sha256": sha256,
-                     "size": os.path.getsize(self.path)},
+            "head": head,
             "retained": retained,
         }
         d = os.path.dirname(os.path.abspath(self.manifest_path))
@@ -180,6 +212,20 @@ class CheckpointLineage:
         except OSError:
             pass  # already gone — retention is best-effort
 
+    def _unlink_shard(self, fname) -> None:
+        """Delete one dropped shard file (+ its multi-host ``.sha256``
+        sidecar) — only the epoch-qualified ``P.ep*.shard*`` names the
+        sharded saver created and the manifest stopped referencing."""
+        name = str(fname or "")
+        if not (name.startswith(os.path.basename(self.path) + ".ep")
+                and ".shard" in name):
+            return
+        for victim in (name, name + ".sha256"):
+            try:
+                os.unlink(self._resolve(victim))
+            except OSError:
+                pass  # already gone — retention is best-effort
+
 
 # -- read side (every rank, at resume / on_nan-restore time) --------------
 
@@ -207,7 +253,14 @@ def _candidates(path: str) -> List[Tuple[str, Optional[str]]]:
                 _log(f"WARNING: checkpoint manifest lists {fp!r} but the "
                      "file is gone; skipping it as a restore candidate")
     else:
-        rotated = sorted(glob.glob(glob.escape(path) + ".ep*"), reverse=True)
+        # Manifest-less scan: rotated heads are exactly ``P.ep<digits>`` —
+        # the sharded format's ``P.ep*.shard*`` data files live in the
+        # same namespace and are NOT restore candidates themselves (their
+        # epoch's index is).
+        rotated = sorted(
+            (fp for fp in glob.glob(glob.escape(path) + ".ep*")
+             if re.fullmatch(r"\.ep\d+", fp[len(path):])),
+            reverse=True)
         out.extend((fp, None) for fp in rotated)
     return out
 
@@ -238,7 +291,8 @@ def _resolve_head(path: str) -> str:
 
 
 def latest_verifiable(
-        path: Optional[str]) -> Optional[Tuple[Checkpoint, str]]:
+        path: Optional[str],
+        loader=None) -> Optional[Tuple[Checkpoint, str]]:
     """Restore the newest verifiable checkpoint under ``path`` — the ONE
     manifest-walking selection both the trainer's resume and the serve
     engine's model load go through (a head checkpoint path, or a
@@ -248,9 +302,18 @@ def latest_verifiable(
     candidate whose manifest sha256 mismatches is logged and still
     *attempted* (a stale manifest — e.g. a preemption between the head
     write and the manifest write — must not discard a good head); a
-    candidate ``load_checkpoint`` rejects (torn/foreign file) is logged and
-    skipped.  Falling back past the head is a recoverable, loudly-logged
-    event — the behavior today's single-file resume cannot offer.
+    candidate the loader rejects (torn/foreign file, torn or missing
+    SHARD of a v2 sharded set) is logged and skipped.  Falling back past
+    the head is a recoverable, loudly-logged event — the behavior today's
+    single-file resume cannot offer.
+
+    ``loader`` maps a candidate file to a :class:`Checkpoint` — default
+    ``load_checkpoint`` (host arrays, both formats).  The trainer and the
+    serve engine pass ``ckpt_shard.load_for_mesh`` bound to their live
+    mesh instead, so a sharded snapshot redistributes straight onto the
+    surviving topology (elastic resume) with the SAME walk and fallback
+    semantics: a loader must raise :class:`CheckpointError` for a
+    candidate that cannot restore.
 
     Returns ``(checkpoint, file_used)``; ``None`` when no candidate exists
     at all (fresh training); raises :class:`CheckpointError` naming every
@@ -258,6 +321,8 @@ def latest_verifiable(
     """
     if not path:
         return None
+    if loader is None:
+        loader = load_checkpoint
     path = _resolve_head(path)
     cands = _candidates(path)
     tried: List[Tuple[str, str]] = []
@@ -273,7 +338,7 @@ def latest_verifiable(
                      "manifest (stale manifest or file damage); attempting "
                      "restore anyway")
         try:
-            ck = load_checkpoint(fp)
+            ck = loader(fp)
         except FileNotFoundError:
             tried.append((fp, "vanished before it could be read"))
             continue

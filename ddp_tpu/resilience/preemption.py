@@ -38,7 +38,12 @@ import numpy as np
 # EX_TEMPFAIL: "temporary failure, retry" — the restart wrapper's cue that
 # an emergency checkpoint is on disk and a ``--resume`` relaunch will
 # continue the run.  Distinct from 0 (done), 1 (real failure), and the
-# watchdog's 124 (no progress).
+# watchdog's 124 (no progress).  The relaunch does NOT need the same
+# topology: restore redistributes either checkpoint format onto whatever
+# mesh the relaunch builds (train/ckpt_shard.py), so a preemption that
+# SHRINKS the pod — the common cloud case: some hosts never come back —
+# is survivable by resuming with the surviving ``--mesh_shape`` (elastic
+# resume; RUNBOOK §11).
 EMERGENCY_CHECKPOINT_EXIT_STATUS = 75
 
 
@@ -56,7 +61,8 @@ class PreemptionInterrupt(BaseException):
         self.path = path
         super().__init__(
             f"preempted: emergency checkpoint at epoch {epoch}"
-            + (f" in {path!r}" if path else " (checkpointing disabled)"))
+            + (f" in {path!r} (any mesh shape can --resume it)" if path
+               else " (checkpointing disabled)"))
 
 
 class PreemptionGuard:

@@ -130,11 +130,24 @@ class ServeEngine:
         (a head path or a directory) through the SAME lineage walk the
         trainer's ``--resume`` uses — ``resilience.lineage
         .latest_verifiable`` — so a torn head falls back to the newest
-        retained snapshot instead of serving nothing."""
+        retained snapshot instead of serving nothing.
+
+        The loader is ``ckpt_shard.load_for_mesh`` bound to the SERVING
+        mesh: a training run that wrote per-host SHARD files
+        (``--ckpt_format sharded``, any (d, m) shape) serves on this
+        engine's own — typically 1-D — mesh with no conversion step, the
+        leaves assembled shard-by-shard straight onto their replicated
+        serving placement (never a whole-pytree host copy); gathered v1
+        files stream leaf-by-leaf the same way."""
+        import functools
+
         from ..models import get_model
         from ..resilience.lineage import latest_verifiable
         from ..train.checkpoint import CheckpointError
-        loaded = latest_verifiable(snapshot_path)
+        from ..train.ckpt_shard import load_for_mesh
+        loaded = latest_verifiable(
+            snapshot_path,
+            loader=functools.partial(load_for_mesh, mesh=mesh))
         if loaded is None:
             raise CheckpointError(
                 f"no checkpoint found under {snapshot_path!r}; the serve "

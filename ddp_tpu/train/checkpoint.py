@@ -32,8 +32,11 @@ _SECTIONS = ("params", "batch_stats", "momentum")
 # Bump when the on-disk layout changes incompatibly.  Version 1 is the
 # round-1..3 layout (section/key/subkey npz + meta/step + meta/epoch);
 # files written before the version field existed are exactly this layout,
-# so a missing field reads as 1.
-FORMAT_VERSION = 1
+# so a missing field reads as 1.  Version 2 is the SHARDED layout
+# (train/ckpt_shard.py): the head file is a small index whose manifest
+# names per-model-shard files — this module reads both transparently.
+FORMAT_VERSION = 2
+GATHERED_FORMAT_VERSION = 1
 
 
 class CheckpointError(ValueError):
@@ -90,6 +93,40 @@ def sha256_of_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+class Sha256Writer:
+    """Write-only stream wrapper hashing every byte on its way to disk, so
+    a checkpoint costs ONE disk pass (write) instead of two (write, then
+    re-read for :func:`sha256_of_file`).
+
+    Deliberately NOT seekable: ``zipfile`` (under ``np.savez``) rewrites
+    member headers in place on a seekable stream — bytes the hash would
+    then double-count or miss — but on a non-seekable one it switches to
+    data descriptors and writes strictly sequentially, making the running
+    digest provably the digest of the file's final bytes.  ``read`` exists
+    only so numpy's file-like sniff takes the stream branch; calling it is
+    an error."""
+
+    def __init__(self, f):
+        self._f = f
+        self._h = hashlib.sha256()
+
+    def write(self, b) -> int:
+        self._h.update(b)
+        return self._f.write(b)
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def seekable(self) -> bool:
+        return False
+
+    def read(self, *args):
+        raise OSError("Sha256Writer is write-only")
+
+    def hexdigest(self) -> str:
+        return self._h.hexdigest()
+
+
 def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
                     step: int, epoch: int, tracer=None) -> str:
     """Atomic overwrite-in-place write (the reference overwrites too,
@@ -122,44 +159,141 @@ def _save_checkpoint_body(path: str, params, batch_stats,
         flat.update({f"{section}/{k}": v for k, v in sect_flat.items()})
     flat["meta/step"] = np.asarray(int(step), np.int64)
     flat["meta/epoch"] = np.asarray(int(epoch), np.int64)
-    flat["meta/format_version"] = np.asarray(FORMAT_VERSION, np.int64)
+    # The gathered layout is unchanged since round 1, so it keeps version 1
+    # (older builds restore these files); only the sharded index
+    # (ckpt_shard.py) writes version 2.
+    flat["meta/format_version"] = np.asarray(GATHERED_FORMAT_VERSION,
+                                             np.int64)
+    return write_npz_hashed(path, flat)
+
+
+def write_npz_hashed(path: str, flat: Dict[str, np.ndarray]) -> str:
+    """Atomic tmp-write + rename of one npz, hashed WHILE writing (one
+    disk pass — satellite of ISSUE 6); returns the file's sha256.  Shared
+    by the gathered save above and every sharded-format file
+    (ckpt_shard.py)."""
     d = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
     try:
         with os.fdopen(fd, "wb") as f:
-            np.savez(f, **flat)
-        sha = sha256_of_file(tmp)
+            w = Sha256Writer(f)
+            np.savez(w, **flat)
         os.replace(tmp, path)
-        return sha
+        return w.hexdigest()
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
 
 
-def load_checkpoint(path: str) -> Checkpoint:
-    """Restore everything ``save_checkpoint`` wrote (the path the reference
-    never built — SURVEY.md §3.4 'resume is absent').
+class LazyLeaf:
+    """One checkpoint array, read from the (open) npz on demand.
 
-    Raises :class:`CheckpointError` — not raw ``zipfile``/``KeyError``
-    internals — on a torn, foreign, or future-format file, naming the path
-    and the problem (resume is a headline feature; its failure mode must be
-    diagnosable).  The save path writes atomically, so a torn file here
-    means external truncation/copy damage, not a crashed save."""
+    ``load_checkpoint`` used to materialise every array eagerly
+    (``{k: z[k] for k in z.files}``), so a restore held the whole model
+    TWICE on the host — the numpy tree plus the device copies being made
+    from it.  A lazy leaf reads its member only when converted
+    (``np.asarray`` / ``jnp.asarray``, via ``__array__``); the Trainer's
+    per-leaf ``tree_map(jnp.asarray, ...)`` then holds at most ONE leaf's
+    host buffer at a time, and the numpy bytes are dropped as soon as the
+    device copy exists.  Repeat conversions re-read the file — deliberate:
+    caching would quietly rebuild the double-buffer this class removes.
+    """
+
+    __slots__ = ("_z", "_key", "_path", "_meta")
+
+    def __init__(self, z, key: str, path: str):
+        self._z = z
+        self._key = key
+        self._path = path
+        self._meta = None  # (shape, dtype), header-only peek, cached
+
+    def __array__(self, dtype=None):
+        try:
+            arr = self._z[self._key]
+        except Exception as e:  # zlib/CRC/zipfile damage at member level
+            raise CheckpointError(
+                f"checkpoint {self._path!r}: array {self._key!r} is "
+                f"unreadable ({type(e).__name__}: {e}); the file is torn "
+                "past its directory — fall back to a retained snapshot"
+            ) from e
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def _peek(self):
+        if self._meta is None:
+            try:
+                name = (self._key + ".npy"
+                        if self._key + ".npy" in self._z.zip.namelist()
+                        else self._key)
+                with self._z.zip.open(name) as f:
+                    ver = np.lib.format.read_magic(f)
+                    read = (np.lib.format.read_array_header_1_0
+                            if ver == (1, 0)
+                            else np.lib.format.read_array_header_2_0)
+                    shape, _, dtype = read(f)
+                self._meta = (shape, dtype)
+            except Exception:  # odd header version: one full read instead
+                arr = self.__array__()
+                self._meta = (arr.shape, arr.dtype)
+        return self._meta
+
+    @property
+    def shape(self):
+        return self._peek()[0]
+
+    @property
+    def dtype(self):
+        return self._peek()[1]
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self) -> str:
+        return (f"LazyLeaf({self._key!r} of {self._path!r}, "
+                f"shape={self.shape}, dtype={self.dtype})")
+
+
+def open_npz(path: str):
+    """``np.load`` with the torn/foreign failure modes converted to
+    :class:`CheckpointError` (a missing path keeps ``FileNotFoundError``
+    so callers' fall-back-to-fresh-training idiom works)."""
     try:
-        with np.load(path) as z:
-            flat = {k: z[k] for k in z.files}
+        return np.load(path)
     except FileNotFoundError:
-        # A missing path is not a corrupt file — keep the standard
-        # exception so callers' fall-back-to-fresh-training idiom works.
         raise
     except Exception as e:  # BadZipFile / OSError / pickle guard / EOF
         raise CheckpointError(
             f"checkpoint {path!r} is not a readable npz archive "
             f"({type(e).__name__}: {e}); the file is torn or is not a "
             "ddp_tpu checkpoint") from e
-    def _scalar(key: str, default=None) -> int:
-        val = flat.get(key, default)
+
+
+def load_checkpoint(path: str, *, verify: bool = True) -> Checkpoint:
+    """Restore everything the save path wrote (the path the reference
+    never built — SURVEY.md §3.4 'resume is absent') — either layout:
+    a gathered v1 file, or a v2 sharded index (train/ckpt_shard.py),
+    whose shards are verified and assembled transparently.
+
+    Arrays come back as :class:`LazyLeaf`s (one host buffer per leaf at
+    conversion time, not the whole model up front); metadata, file
+    structure and — with ``verify`` — every member's CRC are validated
+    eagerly, so a truncated, foreign, or bytes-damaged file still fails
+    HERE, inside the lineage walk where fallback can happen (laziness
+    removes the whole-model host buffer, it must not also defer torn-file
+    detection past the walk).  ``verify=False`` skips the CRC stream for
+    callers that convert every leaf immediately anyway
+    (ckpt_shard._load_v1_for_mesh) — conversion makes the same check.
+    Raises :class:`CheckpointError` — not raw ``zipfile``/``KeyError``
+    internals — naming the path and the problem (resume is a headline
+    feature; its failure mode must be diagnosable).  The save path writes
+    atomically, so a torn file here means external truncation/copy
+    damage, not a crashed save."""
+    z = open_npz(path)
+    files = set(z.files)
+
+    def _scalar(key: str) -> int:
+        val = z[key] if key in files else None
         try:
             return int(val)
         except (TypeError, ValueError) as e:
@@ -168,17 +302,41 @@ def load_checkpoint(path: str) -> Checkpoint:
                 f"(shape {getattr(val, 'shape', '?')}); the file was not "
                 "written by ddp_tpu or is damaged") from e
 
-    version = _scalar("meta/format_version", 1)
+    version = _scalar("meta/format_version") \
+        if "meta/format_version" in files else 1
     if version > FORMAT_VERSION:
         raise CheckpointError(
             f"checkpoint {path!r} has format_version {version}, newer than "
             f"this build's {FORMAT_VERSION}; upgrade ddp_tpu to restore it")
-    missing = [k for k in ("meta/step", "meta/epoch") if k not in flat]
-    sections: Dict[str, Dict[str, np.ndarray]] = {s: {} for s in _SECTIONS}
-    for key, val in flat.items():
+    if version >= 2:
+        # Sharded index: per-leaf assembly over the shard set (verified
+        # shard hashes, per-leaf laziness) lives with the format.
+        z.close()
+        from .ckpt_shard import assemble_checkpoint
+        return assemble_checkpoint(path)
+    if verify:
+        # One streamed CRC pass over the archive (O(chunk) memory): the
+        # eager {k: z[k]} read this module used to do caught mid-file
+        # byte damage at load time; LazyLeaf must not silently move that
+        # failure past the lineage walk's fallback.
+        try:
+            bad = z.zip.testzip()
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint {path!r} has unreadable member data "
+                f"({type(e).__name__}: {e}); the file is torn past its "
+                "directory — fall back to a retained snapshot") from e
+        if bad is not None:
+            raise CheckpointError(
+                f"checkpoint {path!r}: member {bad!r} fails its CRC; the "
+                "file is damaged past its directory — fall back to a "
+                "retained snapshot")
+    missing = [k for k in ("meta/step", "meta/epoch") if k not in files]
+    sections: Dict[str, Dict[str, Any]] = {s: {} for s in _SECTIONS}
+    for key in files:
         section, _, rest = key.partition("/")
         if section in sections:
-            sections[section][rest] = val
+            sections[section][rest] = LazyLeaf(z, key, path)
     # batch_stats may be legitimately empty (a BN-free model); momentum
     # always mirrors params, so params-without-momentum means a foreign
     # or partially-written file — better a named error here than an

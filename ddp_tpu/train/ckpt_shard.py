@@ -1,0 +1,627 @@
+"""Sharded checkpoints + the portable resharding engine (ISSUE 6).
+
+The gathered (v1) save is correct but pays O(model) per host twice over:
+the tensor-parallel trainer all-gathers every model-sharded leaf into a
+replicated copy before writing, and the single ``.npz`` serializes the
+whole model through one stream.  Both costs scale with MODEL size, not
+per-host SHARD size — the exact cliff *Memory-efficient array
+redistribution through portable collective communication* (arXiv
+2112.01075) and veScale (arXiv 2509.07003, both in PAPERS.md) exist to
+remove.  This module is that alternative:
+
+SAVE (``save_checkpoint_sharded``) writes one shard file per MODEL-AXIS
+SLOT — slot k holds every leaf's k-th model-slice (replicated leaves ride
+in slot 0) — plus a small v2 INDEX at the head path mapping each leaf to
+(mesh shape, PartitionSpec, shard dim, dtype) and each shard file to its
+sha256.  Nothing is gathered: shard bytes come straight off the devices
+via ``jax.Array.addressable_shards``, one slot materialised on the host
+at a time, every file hashed WHILE it streams to disk
+(``checkpoint.Sha256Writer``).  Peak host memory and write wall time are
+O(model / m) instead of O(model).
+
+RESTORE (``load_for_mesh``) reads the index, verifies every shard's
+sha256 (a streamed O(chunk)-memory pass — the whole snapshot must be
+verifiable for the lineage walk's fallback contract, so the integrity
+READ is O(model) even though ASSEMBLY is not; ``verify=False`` on
+``open_shard_set`` is the opt-out), and builds each live leaf with
+``jax.make_array_from_callback``:
+the callback slices exactly the saved-slot ranges that overlap the
+requested device shard, so any saved (d, m) layout redistributes onto any
+live (d', m') layout — (2,4) -> (4,2)/(8,1)/(2,2) — without any host ever
+materialising the full pytree (``HostBytesProbe`` makes that a measured,
+asserted number, not a claim).  This is what makes resume ELASTIC: after
+a preemption shrinks the pod, ``--resume`` reshards onto the surviving
+mesh instead of dying (composing with resilience/preemption.py's exit-75
+machinery).
+
+The head INDEX file is what the lineage manifest hashes and rotates, so
+``latest_verifiable``'s torn-file/fallback semantics carry over unchanged
+— a torn or missing SHARD fails the candidate with a named
+:class:`CheckpointError` and the walk falls back to the newest retained
+snapshot, exactly like a torn v1 head.  ``checkpoint.load_checkpoint``
+delegates v2 files here, so every canonical consumer (serve, --on_nan
+restore, tooling) reads sharded snapshots transparently.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, model_axis_size
+from ..parallel.tp.plan import spec_to_json
+from ..optim.sgd import SGDState
+from .checkpoint import (Checkpoint, CheckpointError, _SECTIONS, _unflatten,
+                         open_npz, sha256_of_file, write_npz_hashed)
+
+SHARD_FORMAT_VERSION = 2
+INDEX_KEY = "meta/shard_index_json"
+# Multi-host: how long rank 0 waits for its peers' shard sidecars to land
+# on the shared checkpoint store before declaring the save failed.
+SIDECAR_TIMEOUT_SECS = 300.0
+
+
+def shard_file_name(path: str, epoch: int, slot: int, n_slots: int) -> str:
+    """Shard file NAME (head-path sibling).  Epoch-qualified so rotation
+    works by construction: ``os.replace`` of the head index never
+    invalidates a retained epoch's shard set, and the lineage manifest
+    can trim a dropped epoch's shards by name."""
+    return (f"{os.path.basename(path)}.ep{int(epoch):08d}"
+            f".shard{slot:05d}-of-{n_slots:05d}.npz")
+
+
+# -- host-memory probe -----------------------------------------------------
+
+
+class HostBytesProbe:
+    """Counts the restore engine's live host staging bytes — the number
+    the 'no host ever holds the full gathered pytree' acceptance is
+    asserted on (tests/test_tp.py) and ``bench.py --ckpt_bench`` records.
+    """
+
+    def __init__(self):
+        self.current = 0
+        self.peak = 0
+
+    def alloc(self, nbytes: int) -> None:
+        self.current += int(nbytes)
+        if self.current > self.peak:
+            self.peak = self.current
+
+    def free(self, nbytes: int) -> None:
+        self.current -= int(nbytes)
+
+
+# -- save side -------------------------------------------------------------
+
+
+def _leaf_layout(key: str, leaf) -> Tuple[Tuple, Optional[int]]:
+    """(spec entries, model-sharded dim) of one live leaf.  Host arrays
+    and replicated device arrays are (all-None, None); a leaf sharded
+    over ``data`` is refused — checkpoint leaves are data-replicated by
+    construction (the ZeRO buffer is converted to its canonical pytree
+    before any save)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return (), None
+    entries = tuple(spec)
+    shard_dim = None
+    for dim, entry in enumerate(entries):
+        names = (entry,) if isinstance(entry, str) else tuple(entry or ())
+        if DATA_AXIS in names:
+            raise ValueError(
+                f"checkpoint leaf {key!r} is sharded over the data axis "
+                f"(spec {spec}); saved leaves must be data-replicated")
+        if MODEL_AXIS in names:
+            if shard_dim is not None:
+                raise ValueError(
+                    f"checkpoint leaf {key!r} is model-sharded on two "
+                    f"dims (spec {spec}); one sharded dim per leaf")
+            shard_dim = dim
+    return entries, shard_dim
+
+
+def _flatten_leaves(tree: Any, prefix: str, out: List[Tuple[str, Any]]):
+    """checkpoint._flatten's walk WITHOUT the np.asarray coercion (leaves
+    stay device arrays so shard bytes come off ``addressable_shards``) —
+    same separator guard, so a '/'-containing key fails loudly at save
+    time instead of round-tripping as a different tree."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            if "/" in k:
+                raise ValueError(f"checkpoint key {k!r} contains '/'")
+            _flatten_leaves(tree[k], f"{prefix}/{k}" if prefix else k, out)
+    else:
+        out.append((prefix, tree))
+
+
+def _slot_owner(mesh: Mesh, slot: int) -> int:
+    """Lowest process index owning a device in model column ``slot`` —
+    the one writer of that slot's shard file (per-host parallel writers,
+    no write ever duplicated)."""
+    if MODEL_AXIS not in mesh.axis_names:
+        return min(d.process_index for d in mesh.devices.flat)
+    dim = mesh.axis_names.index(MODEL_AXIS)
+    col = np.moveaxis(mesh.devices, dim, 0)[slot]
+    return min(d.process_index for d in np.asarray(col).flat)
+
+
+def _shard_for_slot(leaf, shard_dim: int, n_slots: int) -> Dict[int, Any]:
+    """slot -> device shard (one representative per distinct model-slice
+    among this process's addressable shards)."""
+    width = leaf.shape[shard_dim] // n_slots
+    out: Dict[int, Any] = {}
+    for s in leaf.addressable_shards:
+        sl = s.index[shard_dim]
+        start = 0 if sl.start is None else int(sl.start)
+        slot = start // width
+        if slot not in out:
+            out[slot] = s
+    return out
+
+
+def save_checkpoint_sharded(path: str, params, batch_stats, opt_state,
+                            step: int, epoch: int, *, mesh: Mesh,
+                            tracer=None) -> Tuple[Optional[str], List[str]]:
+    """Write the sharded (v2) checkpoint: per-slot shard files + the head
+    index at ``path``.  Returns ``(index_sha, shard_file_names)`` — the
+    sha is ``None`` on processes that do not write the index (rank > 0).
+
+    Single-host this is one writer streaming m small files instead of one
+    big one; multi-host each process writes only the slots it owns
+    (plus a tiny ``.sha256`` sidecar), and rank 0 assembles the index once
+    every sidecar has landed on the shared store.  Telemetry matches the
+    gathered save: one ``ckpt_write`` overlap span on the writer thread.
+    """
+    from ..obs.tracer import get_tracer
+    tracer = tracer if tracer is not None else get_tracer()
+    with tracer.span("ckpt_write", step=int(step), overlap=True):
+        return _save_sharded_body(path, params, batch_stats, opt_state,
+                                  step, epoch, mesh=mesh)
+
+
+def _save_sharded_body(path, params, batch_stats, opt_state, step, epoch,
+                       *, mesh):
+    m = model_axis_size(mesh)
+    pid = jax.process_index()
+    multi = jax.process_count() > 1
+    # Per-slot work lists + the leaf manifest, one walk over all sections.
+    slot_work: Dict[int, List[Tuple[str, Any, Optional[int]]]] = {
+        k: [] for k in range(m)}
+    leaves_meta: Dict[str, Dict[str, Any]] = {}
+    for section, tree in zip(_SECTIONS,
+                             (params, batch_stats, opt_state.momentum_buf)):
+        flat: List[Tuple[str, Any]] = []
+        _flatten_leaves(tree, "", flat)
+        for rest, leaf in flat:
+            key = f"{section}/{rest}"
+            entries, shard_dim = _leaf_layout(key, leaf)
+            shape = tuple(int(s) for s in np.shape(leaf))
+            if shard_dim is not None and shape[shard_dim] % m:
+                raise ValueError(
+                    f"leaf {key!r} dim {shard_dim} extent "
+                    f"{shape[shard_dim]} not divisible by the model axis "
+                    f"size {m}")
+            leaves_meta[key] = {
+                "spec": spec_to_json(P(*entries)),
+                "shape": list(shape),
+                "dtype": str(np.dtype(getattr(leaf, "dtype", np.float64))),
+                "shard_dim": shard_dim,
+            }
+            if shard_dim is None:
+                slot_work[0].append((key, leaf, None))
+            else:
+                for slot, shard in _shard_for_slot(leaf, shard_dim,
+                                                   m).items():
+                    slot_work[slot].append((key, shard, shard_dim))
+    d = os.path.dirname(os.path.abspath(path))
+    names = [shard_file_name(path, epoch, k, m) for k in range(m)]
+    shas: Dict[int, str] = {}
+    for slot in range(m):
+        if _slot_owner(mesh, slot) != pid:
+            continue
+        # One slot materialised on the host at a time — the O(model/m)
+        # peak the format exists for.  device_get on a Shard's .data is a
+        # single-device copy; replicated leaves ride in slot 0.
+        flat_np: Dict[str, np.ndarray] = {}
+        for key, obj, shard_dim in slot_work[slot]:
+            data = getattr(obj, "data", obj)  # Shard.data | whole leaf
+            flat_np[key] = np.asarray(jax.device_get(data))
+        fpath = os.path.join(d, names[slot])
+        shas[slot] = write_npz_hashed(fpath, flat_np)
+        del flat_np
+        if multi:
+            _write_sidecar(fpath, shas[slot], step=step, epoch=epoch)
+    if pid != 0:
+        return None, names
+    if multi:
+        shas = _collect_sidecars(d, names, step=step, epoch=epoch,
+                                 have=shas)
+    index = {
+        "format": SHARD_FORMAT_VERSION,
+        "mesh_shape": [int(dict(mesh.shape).get(DATA_AXIS, 1)), int(m)],
+        "n_slots": int(m),
+        "shards": [{"file": names[k], "sha256": shas[k]} for k in range(m)],
+        "leaves": leaves_meta,
+    }
+    blob = np.frombuffer(json.dumps(index).encode(), dtype=np.uint8)
+    index_sha = write_npz_hashed(path, {
+        "meta/format_version": np.asarray(SHARD_FORMAT_VERSION, np.int64),
+        "meta/step": np.asarray(int(step), np.int64),
+        "meta/epoch": np.asarray(int(epoch), np.int64),
+        INDEX_KEY: blob,
+    })
+    return index_sha, names
+
+
+def _write_sidecar(fpath: str, sha: str, *, step: int, epoch: int) -> None:
+    tmp = f"{fpath}.sha256.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"sha256": sha, "step": int(step),
+                   "epoch": int(epoch)}, f)
+    os.replace(tmp, f"{fpath}.sha256")
+
+
+def _collect_sidecars(d: str, names: List[str], *, step: int, epoch: int,
+                      have: Dict[int, str]) -> Dict[int, str]:
+    """Rank 0, multi-host: wait for every peer slot's sidecar on the
+    shared store (matched on (step, epoch) so a stale file from the
+    previous save of the same path never masquerades as this one)."""
+    deadline = time.monotonic() + SIDECAR_TIMEOUT_SECS
+    out = dict(have)
+    pending = [k for k in range(len(names)) if k not in out]
+    while pending:
+        still = []
+        for k in pending:
+            try:
+                with open(os.path.join(d, names[k]) + ".sha256") as f:
+                    rec = json.load(f)
+                if (int(rec.get("step", -1)) == int(step)
+                        and int(rec.get("epoch", -1)) == int(epoch)):
+                    out[k] = rec["sha256"]
+                    continue
+            except (OSError, ValueError, KeyError):
+                pass
+            still.append(k)
+        pending = still
+        if pending:
+            if time.monotonic() > deadline:
+                raise CheckpointError(
+                    f"sharded save: peer shard(s) "
+                    f"{[names[k] for k in pending]} never landed within "
+                    f"{SIDECAR_TIMEOUT_SECS:.0f}s; is the checkpoint "
+                    "directory on shared storage?")
+            time.sleep(0.2)
+    return out
+
+
+# -- read side -------------------------------------------------------------
+
+
+def read_shard_index(path: str) -> Optional[Dict[str, Any]]:
+    """The v2 index at ``path`` (with ``step``/``epoch`` folded in), or
+    ``None`` for a v1 gathered file.  :class:`CheckpointError` on a torn
+    or future-format file."""
+    z = open_npz(path)
+    try:
+        ver = (int(z["meta/format_version"])
+               if "meta/format_version" in z.files else 1)
+        if ver > SHARD_FORMAT_VERSION:
+            # Same refusal load_checkpoint makes — this is the production
+            # --resume/serve entry (load_for_mesh), so a future layout
+            # must fail loudly here too, not restore under v2 assumptions.
+            raise CheckpointError(
+                f"checkpoint {path!r} has format_version {ver}, newer "
+                f"than this build's {SHARD_FORMAT_VERSION}; upgrade "
+                "ddp_tpu to restore it")
+        if INDEX_KEY not in z.files:
+            if ver >= SHARD_FORMAT_VERSION:
+                raise CheckpointError(
+                    f"checkpoint {path!r} claims format_version {ver} but "
+                    "carries no shard index; the file is damaged")
+            return None
+        try:
+            index = json.loads(bytes(bytearray(z[INDEX_KEY])).decode())
+            index["step"] = int(z["meta/step"])
+            index["epoch"] = int(z["meta/epoch"])
+            n_slots = int(index["n_slots"])
+            for entry in index.get("leaves", {}).values():
+                entry["n_slots"] = n_slots
+        except (ValueError, KeyError, TypeError) as e:
+            raise CheckpointError(
+                f"checkpoint {path!r} has an unparseable shard index "
+                f"({type(e).__name__}: {e}); the file is damaged") from e
+        return index
+    finally:
+        z.close()
+
+
+def open_shard_set(path: str, index: Dict[str, Any], *,
+                   verify: bool = True) -> Dict[int, Any]:
+    """slot -> open ``NpzFile`` for every shard the index names.  With
+    ``verify`` each file's streaming sha256 is checked against the index
+    FIRST, so a torn shard fails here — with the shard named — and the
+    lineage walk can fall back, exactly like a torn v1 head."""
+    d = os.path.dirname(os.path.abspath(path))
+    out: Dict[int, Any] = {}
+    try:
+        for slot, rec in enumerate(index.get("shards", [])):
+            fpath = os.path.join(d, str(rec.get("file", "")))
+            if not os.path.exists(fpath):
+                raise CheckpointError(
+                    f"checkpoint {path!r}: shard file {rec.get('file')!r} "
+                    "is MISSING; the shard set is incomplete — fall back "
+                    "to a retained snapshot")
+            if verify and rec.get("sha256"):
+                actual = sha256_of_file(fpath)
+                if actual != rec["sha256"]:
+                    raise CheckpointError(
+                        f"checkpoint {path!r}: shard file "
+                        f"{rec.get('file')!r} sha256 mismatch (torn or "
+                        "damaged shard) — fall back to a retained "
+                        "snapshot")
+            out[slot] = open_npz(fpath)
+        return out
+    except BaseException:
+        for z in out.values():
+            z.close()
+        raise
+
+
+def _read_range(zs: Dict[int, Any], key: str, entry: Dict[str, Any],
+                index_slices: Tuple[slice, ...], path: str,
+                probe: Optional[HostBytesProbe]) -> np.ndarray:
+    """The saved bytes for one requested device-shard index of one leaf:
+    reads only the saved slots overlapping the request, concatenates
+    along the saved shard dim, then applies the request's remaining
+    dims."""
+    shape = tuple(entry["shape"])
+    dim = entry["shard_dim"]
+
+    def member(slot: int) -> np.ndarray:
+        z = zs.get(slot if dim is not None else 0)
+        if z is None or key not in z.files:
+            raise CheckpointError(
+                f"checkpoint {path!r}: array {key!r} missing from shard "
+                f"slot {slot}; the shard set is inconsistent")
+        try:
+            return z[key]
+        except Exception as e:
+            raise CheckpointError(
+                f"checkpoint {path!r}: shard member {key!r} is unreadable "
+                f"({type(e).__name__}: {e}); torn shard") from e
+
+    # Probe contract: the RETURNED buffer is the caller's to account;
+    # only transient buffers (members read then dropped) are tracked —
+    # and copies are made precisely so views never pin those members.
+    if dim is None:
+        arr = member(0)
+        if not index_slices or all(
+                s.start is None and s.stop is None for s in index_slices):
+            return arr  # the full leaf: no transient, no copy
+        if probe:
+            probe.alloc(arr.nbytes)
+        out = np.ascontiguousarray(arr[index_slices])
+        if probe:
+            probe.free(arr.nbytes)
+        return out
+    n_slots = int(entry["n_slots"])
+    width = shape[dim] // n_slots
+    sl = index_slices[dim] if dim < len(index_slices) else slice(None)
+    a = 0 if sl.start is None else int(sl.start)
+    b = shape[dim] if sl.stop is None else int(sl.stop)
+    parts: List[np.ndarray] = []
+    held = 0
+    first, last = a // width, (b - 1) // width
+    for slot in range(first, last + 1):
+        arr = member(slot)
+        if probe:
+            probe.alloc(arr.nbytes)
+            held += arr.nbytes
+        lo = max(a, slot * width) - slot * width
+        hi = min(b, (slot + 1) * width) - slot * width
+        parts.append(arr[(slice(None),) * dim + (slice(lo, hi),)])
+    block = parts[0] if len(parts) == 1 else np.concatenate(parts, axis=dim)
+    rest = list(index_slices) if index_slices else [slice(None)] * len(shape)
+    while len(rest) < len(shape):
+        rest.append(slice(None))
+    rest[dim] = slice(None)
+    # Contiguous copy when the result would otherwise be a view pinning a
+    # member's whole buffer — the members must be droppable right here.
+    out = np.ascontiguousarray(block[tuple(rest)])
+    if probe:
+        probe.free(held)
+    return out
+
+
+class _ShardLeaf:
+    """Full-leaf lazy assembly over the shard set — what
+    ``checkpoint.load_checkpoint`` hands canonical consumers for a v2
+    file (same conversion-time contract as ``checkpoint.LazyLeaf``)."""
+
+    __slots__ = ("_zs", "_key", "_entry", "_path")
+
+    def __init__(self, zs, key, entry, path):
+        self._zs = zs
+        self._key = key
+        self._entry = entry
+        self._path = path
+
+    def __array__(self, dtype=None):
+        full = tuple(slice(None) for _ in self._entry["shape"])
+        arr = _read_range(self._zs, self._key, self._entry, full,
+                          self._path, None)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    @property
+    def shape(self):
+        return tuple(self._entry["shape"])
+
+    @property
+    def dtype(self):
+        return np.dtype(self._entry["dtype"])
+
+    @property
+    def ndim(self) -> int:
+        return len(self._entry["shape"])
+
+    def __repr__(self) -> str:
+        return (f"_ShardLeaf({self._key!r} of {self._path!r}, "
+                f"shape={self.shape}, dtype={self.dtype})")
+
+
+def assemble_checkpoint(path: str) -> Checkpoint:
+    """Canonical (host-array) view of a v2 sharded checkpoint — the
+    ``load_checkpoint`` delegate.  Shard hashes are verified up front;
+    leaves assemble lazily per conversion."""
+    index = read_shard_index(path)
+    if index is None:
+        raise CheckpointError(
+            f"checkpoint {path!r} is not a sharded (v2) checkpoint")
+    zs = open_shard_set(path, index)
+    leaves = index.get("leaves", {})
+    sections: Dict[str, Dict[str, Any]] = {s: {} for s in _SECTIONS}
+    for key, entry in leaves.items():
+        section, _, rest = key.partition("/")
+        if section in sections:
+            sections[section][rest] = _ShardLeaf(zs, key, entry, path)
+    if not sections["params"] or not sections["momentum"]:
+        raise CheckpointError(
+            f"checkpoint {path!r} has a shard index but no "
+            "params/momentum leaves; it was not written by ddp_tpu or is "
+            "damaged")
+    return Checkpoint(
+        params=_unflatten(sections["params"]),
+        batch_stats=_unflatten(sections["batch_stats"]),
+        opt_state=SGDState(_unflatten(sections["momentum"])),
+        step=int(index["step"]),
+        epoch=int(index["epoch"]),
+    )
+
+
+# -- the resharding restore ------------------------------------------------
+
+
+def _flatten_specs(tree: Any) -> Dict[str, P]:
+    out: List[Tuple[str, Any]] = []
+    _flatten_leaves(tree, "", out)
+    return dict(out)
+
+
+def load_for_mesh(path: str, mesh: Mesh, *, param_specs=None,
+                  probe: Optional[HostBytesProbe] = None) -> Checkpoint:
+    """Restore ``path`` DIRECTLY onto ``mesh``: every returned leaf is a
+    committed ``jax.Array`` already carrying its live sharding, built via
+    ``jax.make_array_from_callback`` from exactly the saved bytes each
+    device shard needs.  This is the redistribution layer: any saved
+    (d, m) reshards onto any live (d', m') — elastic resume — and no host
+    ever stages more than a leaf's worth of bytes (``probe`` measures the
+    engine's live staging bytes; the portability tests assert its peak).
+
+    ``param_specs`` is the live plan's per-leaf PartitionSpec tree
+    (params AND momentum follow it — elementwise SGD preserves specs);
+    ``None`` means fully replicated (1-D serving, plain DP).  batch_stats
+    and the counters are always replicated.  v1 gathered files take the
+    same path with a one-slot read, so ``--resume`` accepts either format
+    on any mesh.  Raises :class:`CheckpointError` exactly where
+    ``load_checkpoint`` would (torn index, torn/missing shard, spec
+    drift), so the lineage fallback walk composes unchanged."""
+    specs = _flatten_specs(param_specs) if param_specs is not None else {}
+
+    def target(section: str, rest: str) -> NamedSharding:
+        spec = P()
+        if section in ("params", "momentum") and specs:
+            if rest not in specs:
+                raise CheckpointError(
+                    f"checkpoint {path!r} holds {section}/{rest} but the "
+                    "live model's sharding plan has no such leaf; the "
+                    "snapshot and the model have drifted")
+            spec = specs[rest]
+        return NamedSharding(mesh, spec)
+
+    index = read_shard_index(path)
+    if index is None:
+        return _load_v1_for_mesh(path, mesh, target, probe)
+    zs = open_shard_set(path, index)
+    try:
+        sections: Dict[str, Dict[str, Any]] = {s: {} for s in _SECTIONS}
+        for key, entry in index.get("leaves", {}).items():
+            section, _, rest = key.partition("/")
+            if section not in sections:
+                continue
+            sh = target(section, rest)
+            shape = tuple(entry["shape"])
+            cache: Dict[Tuple, np.ndarray] = {}
+
+            def cb(idx, *, _key=key, _entry=entry, _cache=cache):
+                norm = tuple(
+                    (0 if s.start is None else int(s.start),
+                     _entry["shape"][i] if s.stop is None else int(s.stop))
+                    for i, s in enumerate(idx))
+                if norm not in _cache:
+                    block = _read_range(zs, _key, _entry, tuple(idx), path,
+                                        probe)
+                    if probe:
+                        probe.alloc(block.nbytes)
+                    _cache[norm] = block
+                return _cache[norm]
+
+            arr = jax.make_array_from_callback(shape, sh, cb)
+            sections[section][rest] = arr
+            if probe:
+                probe.free(sum(b.nbytes for b in cache.values()))
+            cache.clear()
+        if not sections["params"] or not sections["momentum"]:
+            raise CheckpointError(
+                f"checkpoint {path!r} has a shard index but no "
+                "params/momentum leaves; damaged or foreign file")
+        return Checkpoint(
+            params=_unflatten(sections["params"]),
+            batch_stats=_unflatten(sections["batch_stats"]),
+            opt_state=SGDState(_unflatten(sections["momentum"])),
+            step=int(index["step"]),
+            epoch=int(index["epoch"]),
+        )
+    finally:
+        for z in zs.values():
+            z.close()
+
+
+def _load_v1_for_mesh(path, mesh, target, probe) -> Checkpoint:
+    """v1 gathered file -> live mesh, one leaf staged at a time (the
+    legacy restore's whole-model double-buffer removed — satellite of
+    ISSUE 6): read member, ``device_put`` with the live sharding, drop
+    the host bytes."""
+    from .checkpoint import load_checkpoint
+    # verify=False: every leaf converts eagerly in place() below, which
+    # makes the member-CRC check itself — no second streamed pass needed.
+    ck = load_checkpoint(path, verify=False)
+    if isinstance(ck.params, dict) and not ck.params:
+        raise CheckpointError(f"checkpoint {path!r} has no params")
+
+    def place(section, tree):
+        flat: List[Tuple[str, Any]] = []
+        _flatten_leaves(tree, "", flat)
+        out: Dict[str, Any] = {}
+        for rest, leaf in flat:
+            arr = np.asarray(leaf)  # the one transient host buffer
+            if probe:
+                probe.alloc(arr.nbytes)
+            out[rest] = jax.device_put(arr, target(section, rest))
+            if probe:
+                probe.free(arr.nbytes)
+        return _unflatten(out)
+
+    return Checkpoint(
+        params=place("params", ck.params),
+        batch_stats=place("batch_stats", ck.batch_stats),
+        opt_state=SGDState(place("momentum", ck.opt_state.momentum_buf)),
+        step=ck.step,
+        epoch=ck.epoch,
+    )

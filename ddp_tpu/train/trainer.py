@@ -95,7 +95,8 @@ class Trainer:
                  prefetch_stats=None,
                  tracer=None,
                  live=None,
-                 tp_plan=None):
+                 tp_plan=None,
+                 ckpt_format: str = "gathered"):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -130,13 +131,25 @@ class Trainer:
         self._health = StepHealthGuard(on_nan)
         self._watchdog = watchdog
         self._preemption = preemption
+        if ckpt_format not in ("gathered", "sharded"):
+            raise ValueError(
+                f"ckpt_format must be 'gathered' or 'sharded', got "
+                f"{ckpt_format!r}")
+        self.ckpt_format = ckpt_format
+        self.tp_plan = tp_plan
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
         if resume and snapshot_path:
             # Lineage-aware restore: the head first, then each retained
             # snapshot — a torn head is a recoverable, logged event, not a
-            # fatal one (fatal only when EVERY candidate is torn).
-            loaded = latest_verifiable(snapshot_path)
+            # fatal one (fatal only when EVERY candidate is torn).  The
+            # mesh-aware loader redistributes whatever format/mesh-shape
+            # is on disk straight onto THIS run's mesh (ckpt_shard.py) —
+            # a (2,4)-written sharded snapshot restores onto the (2,2)
+            # pod that survived a preemption, leaf-streamed, never
+            # gathered (elastic resume).
+            loaded = latest_verifiable(snapshot_path,
+                                       loader=self._ckpt_loader())
             if loaded is not None:
                 ckpt, used = loaded
                 self.state = TrainState(
@@ -160,12 +173,12 @@ class Trainer:
         self.shard_update = shard_update
         self.grad_accum = max(grad_accum, 1)
         # Tensor parallelism (parallel/tp/): a TPPlan on a 2-D (data x
-        # model) mesh.  The state — fresh init or a canonical (replicated)
-        # checkpoint restore — is re-sharded onto the plan's per-leaf
-        # specs here, which is also what makes checkpoints PORTABLE across
-        # mesh shapes: the file stays canonical (save gathers, below) and
-        # restore re-shards onto whatever mesh this run has.
-        self.tp_plan = tp_plan
+        # model) mesh.  The state — fresh init or a checkpoint restore —
+        # is re-sharded onto the plan's per-leaf specs here, which is
+        # also what makes checkpoints PORTABLE across mesh shapes: a
+        # gathered file stays canonical and a sharded set redistributes,
+        # so restore re-shards onto whatever mesh this run has (for a
+        # loader-restored state this device_put is already a no-op).
         if tp_plan is not None:
             from ..parallel.tp.plan import state_shardings
             self.state = jax.device_put(self.state,
@@ -232,6 +245,22 @@ class Trainer:
                 (shard_update, self.grad_accum > 1)]
             self.train_step = build(model, sgd_config, lr_schedule, mesh,
                                     **kw)
+
+    def _ckpt_loader(self):
+        """The lineage walk's candidate loader, bound to THIS run's mesh
+        and plan (train/ckpt_shard.py): a sharded snapshot redistributes
+        its saved (d, m) layout onto the live layout shard-by-shard; a
+        gathered v1 file streams leaf-by-leaf onto its live sharding.
+        Either way no host ever stages the full pytree — and any on-disk
+        format restores onto any mesh shape, which is what makes
+        ``--resume`` after a pod-shrinking preemption work at all."""
+        import functools
+
+        from .ckpt_shard import load_for_mesh
+        specs = (self.tp_plan.param_specs if self.tp_plan is not None
+                 else None)
+        return functools.partial(load_for_mesh, mesh=self.mesh,
+                                 param_specs=specs)
 
     def _epoch_losses_streaming(self):
         """Per-step dispatch over host-fed batches (the reference's loop,
@@ -456,15 +485,20 @@ class Trainer:
             from .zero import opt_shard_to_pytree
             opt_state = opt_shard_to_pytree(self.state.params, opt_state,
                                             self.mesh, plan=self.tp_plan)
-        # Tensor parallelism: SAVE GATHERS — the model-sharded leaves are
-        # resharded to replicated (an all-gather over the ``model`` axis;
-        # collective under multi-host, so it sits BEFORE the rank-0 gate
-        # like the zero conversion above), keeping the file in the one
-        # canonical format every mesh shape can restore (the portability
-        # contract tests/test_tp.py and the 1-D serve path rely on).
+        # Tensor parallelism, --ckpt_format gathered (v1): SAVE GATHERS —
+        # the model-sharded leaves are resharded to replicated (an
+        # all-gather over the ``model`` axis; collective under multi-host,
+        # so it sits BEFORE the rank-0 gate like the zero conversion
+        # above), keeping the file in the one canonical format every mesh
+        # shape can restore.  --ckpt_format sharded SKIPS the gather
+        # entirely — the leaves persist as the per-slot shard files they
+        # already are (ckpt_shard.py), so the save path is O(model/m) per
+        # host in both memory and write stream instead of O(model).
+        # Portability holds either way: restore redistributes.
+        sharded = self.ckpt_format == "sharded"
         params, stats = self.state.params, self.state.batch_stats
         gathered = False
-        if self.tp_plan is not None:
+        if self.tp_plan is not None and not sharded:
             rep = replicated_sharding(self.mesh)
             params, stats, mom = jax.jit(
                 lambda p, s, m: (p, s, m),
@@ -472,7 +506,12 @@ class Trainer:
                                                opt_state.momentum_buf)
             opt_state = SGDState(mom)
             gathered = True
-        if self.gpu_id != 0:  # reference rank-0 gate, multigpu.py:118
+        if self.gpu_id != 0 and not sharded:
+            # Reference rank-0 gate, multigpu.py:118.  The SHARDED format
+            # is written by every host in parallel (each streams only the
+            # model-slots it owns — the per-host-writer contract), so
+            # ranks > 0 fall through to their own writer thread there;
+            # lineage bookkeeping stays rank-0-only inside write().
             return
         # Async write: snapshot the state into FRESH device buffers (an
         # on-device copy — donation-safe: the next epoch's step donates and
@@ -510,13 +549,24 @@ class Trainer:
                 # run lock-free and guarantees it never touches a file
                 # still being written: the in-flight write is a *.tmp name
                 # rotation structurally ignores (resilience/lineage.py).
-                if self.lineage is not None:
+                if self.lineage is not None and self.gpu_id == 0:
                     self.lineage.preserve_head()
-                sha = save_checkpoint(self.snapshot_path, snap_params,
-                                      snap_stats, SGDState(snap_opt), step,
-                                      epoch, tracer=self.tracer)
+                if sharded:
+                    from .ckpt_shard import save_checkpoint_sharded
+                    sha, shard_names = save_checkpoint_sharded(
+                        self.snapshot_path, snap_params, snap_stats,
+                        SGDState(snap_opt), step, epoch, mesh=self.mesh,
+                        tracer=self.tracer)
+                else:
+                    sha = save_checkpoint(self.snapshot_path, snap_params,
+                                          snap_stats, SGDState(snap_opt),
+                                          step, epoch, tracer=self.tracer)
+                    shard_names = None
+                if self.gpu_id != 0:
+                    return  # shard writer only: no lineage, no print
                 if self.lineage is not None:
-                    self.lineage.commit(epoch=epoch, step=step, sha256=sha)
+                    self.lineage.commit(epoch=epoch, step=step, sha256=sha,
+                                        shards=shard_names)
                 # Reference print, singlegpu.py:122.
                 print(f"Epoch {epoch} | Training checkpoint saved at "
                       f"{self.snapshot_path}")
@@ -536,7 +586,8 @@ class Trainer:
         from ..resilience.lineage import latest_verifiable
         self._join_pending_save()  # let any in-flight (good) write land
         self._pending_losses = None  # the poisoned trajectory's records
-        loaded = (latest_verifiable(self.snapshot_path)
+        loaded = (latest_verifiable(self.snapshot_path,
+                                    loader=self._ckpt_loader())
                   if self.snapshot_path else None)
         if loaded is None:
             raise NonFiniteLossError(

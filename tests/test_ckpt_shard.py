@@ -1,0 +1,327 @@
+"""Sharded checkpoint format + resharding engine (train/ckpt_shard.py) —
+ISSUE 6 unit surface.
+
+The cross-mesh-shape portability matrix and its peak-host-bytes
+acceptance live with the tensor-parallel contracts in tests/test_tp.py;
+the lineage torn-/missing-shard fallback drills live with the resilience
+drills in tests/test_resilience.py.  This file pins the format itself:
+single-pass hashing, lazy v1 loads, the v2 index/shard layout, shard
+verification errors, lineage shard-set trimming, and spec round-trips.
+"""
+import json
+import os
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ddp_tpu.models import get_model
+from ddp_tpu.optim.sgd import SGDState
+from ddp_tpu.parallel.mesh import MODEL_AXIS, make_mesh
+from ddp_tpu.parallel.tp.plan import (plan_for_model, spec_from_json,
+                                      spec_to_json, state_shardings)
+from ddp_tpu.resilience.lineage import CheckpointLineage
+from ddp_tpu.train.checkpoint import (CheckpointError, LazyLeaf,
+                                      Sha256Writer, load_checkpoint,
+                                      save_checkpoint, sha256_of_file)
+from ddp_tpu.train.ckpt_shard import (HostBytesProbe, load_for_mesh,
+                                      read_shard_index,
+                                      save_checkpoint_sharded,
+                                      shard_file_name)
+from ddp_tpu.train.step import init_train_state
+
+
+def _flat(tree):
+    return np.asarray(jax.flatten_util.ravel_pytree(jax.device_get(tree))[0])
+
+
+@pytest.fixture(scope="module")
+def tp_state():
+    """DeepNN TrainState sharded per the m=4 plan on a (2,4) mesh."""
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    mesh = make_mesh(shape=(2, 4))
+    plan = plan_for_model("deepnn", jax.device_get(params), stats,
+                          model_size=4)
+    state = init_train_state(jax.tree_util.tree_map(jnp.asarray, params), {})
+    state = jax.device_put(state, state_shardings(plan, mesh))
+    return mesh, plan, state
+
+
+# -- single-pass hashing (satellite) ---------------------------------------
+
+
+def test_sha256_writer_digest_matches_file_bytes(tmp_path):
+    """The stream digest IS the file digest — so a save costs one disk
+    pass, and the non-seekable discipline (zipfile data descriptors)
+    cannot silently drift from the on-disk bytes."""
+    p = str(tmp_path / "x.npz")
+    with open(p, "wb") as f:
+        w = Sha256Writer(f)
+        np.savez(w, **{"a/b": np.arange(100), "c": np.eye(4)})
+    assert w.hexdigest() == sha256_of_file(p)
+    with np.load(p) as z:  # ...and the data-descriptor zip reads fine
+        assert sorted(z.files) == ["a/b", "c"]
+    with pytest.raises(OSError, match="write-only"):
+        w.read()
+
+
+def test_save_checkpoint_sha_is_single_pass(tmp_path, monkeypatch):
+    """save_checkpoint's returned sha matches the file WITHOUT any
+    re-read: sha256_of_file must not run inside the save body."""
+    import ddp_tpu.train.checkpoint as ck_mod
+    calls = []
+    orig = ck_mod.sha256_of_file
+    monkeypatch.setattr(ck_mod, "sha256_of_file",
+                        lambda p, **kw: calls.append(p) or orig(p, **kw))
+    p = str(tmp_path / "ck.pt")
+    sha = save_checkpoint(p, {"w": np.ones((4, 4), np.float32)}, {},
+                          SGDState({"w": np.zeros((4, 4), np.float32)}),
+                          3, 1)
+    assert calls == []  # one pass: hashed while writing
+    assert sha == orig(p)
+
+
+# -- lazy v1 loads (satellite) ---------------------------------------------
+
+
+def test_load_checkpoint_v1_is_lazy_per_leaf(tmp_path):
+    p = str(tmp_path / "ck.pt")
+    w = np.arange(16, dtype=np.float32).reshape(4, 4)
+    save_checkpoint(p, {"w": w}, {"bn": np.ones(3)},
+                    SGDState({"w": np.zeros((4, 4), np.float32)}), 5, 2)
+    ck = load_checkpoint(p)
+    leaf = ck.params["w"]
+    assert isinstance(leaf, LazyLeaf)
+    # Header-only metadata, then conversion on demand.
+    assert leaf.shape == (4, 4) and leaf.dtype == np.float32
+    assert leaf.ndim == 2
+    np.testing.assert_array_equal(np.asarray(leaf), w)
+    np.testing.assert_array_equal(np.asarray(jnp.asarray(leaf)), w)
+    assert ck.step == 5 and ck.epoch == 2
+    # Structural validation stays EAGER: foreign npz rejected at load.
+    q = str(tmp_path / "foreign.npz")
+    np.savez(q, unrelated=np.ones(3))
+    with pytest.raises(CheckpointError, match="not a ddp_tpu"):
+        load_checkpoint(q)
+
+
+def test_lazy_load_still_fails_in_walk_on_crc_damage(tmp_path):
+    """Laziness must not defer torn-file detection past the lineage walk:
+    mid-file byte damage that leaves the zip directory intact (the case
+    the old eager read caught at load time) still raises HERE, where
+    ``latest_verifiable`` can fall back — not later at leaf conversion."""
+    p = str(tmp_path / "ck.pt")
+    save_checkpoint(p, {"w": np.arange(4096, dtype=np.float32)}, {},
+                    SGDState({"w": np.zeros(4096, np.float32)}), 1, 0)
+    with open(p, "r+b") as f:  # flip data bytes well before the directory
+        f.seek(os.path.getsize(p) // 3)
+        f.write(b"\xff" * 64)
+    with pytest.raises(CheckpointError, match="CRC|unreadable|torn"):
+        load_checkpoint(p)
+    # ...and the walk sees the failure (one candidate, all damaged ->
+    # the named every-candidate-tried error, not a deferred crash).
+    from ddp_tpu.resilience.lineage import latest_verifiable
+    with pytest.raises(CheckpointError, match="ck.pt"):
+        latest_verifiable(p)
+
+
+# -- the sharded layout ----------------------------------------------------
+
+
+def test_sharded_save_layout_and_index(tmp_path, tp_state):
+    mesh, plan, state = tp_state
+    p = str(tmp_path / "ck.pt")
+    sha, names = save_checkpoint_sharded(p, state.params, state.batch_stats,
+                                         state.opt_state, 7, 3, mesh=mesh)
+    assert sha == sha256_of_file(p)  # hashed while writing, single pass
+    assert names == [shard_file_name(p, 3, k, 4) for k in range(4)]
+    assert all(os.path.exists(str(tmp_path / n)) for n in names)
+    index = read_shard_index(p)
+    assert index["step"] == 7 and index["epoch"] == 3
+    assert index["mesh_shape"] == [2, 4] and index["n_slots"] == 4
+    assert [s["file"] for s in index["shards"]] == names
+    for s in index["shards"]:
+        assert s["sha256"] == sha256_of_file(str(tmp_path / s["file"]))
+    # Per-leaf records carry the saved spec and the sharded dim.
+    col = index["leaves"]["params/features/conv0/kernel"]
+    assert col["shard_dim"] == 3 and col["spec"][3] == MODEL_AXIS
+    rep = index["leaves"]["params/features/conv1/bias"]  # row bias
+    assert rep["shard_dim"] is None
+    # A model-sharded leaf's bytes really are SPLIT across shard files:
+    # slot k holds exactly the k-th model-slice.
+    with np.load(str(tmp_path / names[1])) as z:
+        piece = z["params/features/conv0/kernel"]
+    full = np.asarray(jax.device_get(
+        state.params["features"]["conv0"]["kernel"]))
+    np.testing.assert_array_equal(piece, full[..., 32:64])  # 128/4-wide
+    # Replicated leaves ride in slot 0 only.
+    with np.load(str(tmp_path / names[2])) as z:
+        assert "params/features/conv1/bias" not in z.files
+    # v1 reader interop: load_checkpoint assembles the v2 set bitwise.
+    np.testing.assert_array_equal(_flat(load_checkpoint(p).params),
+                                  _flat(state.params))
+
+
+def test_sharded_one_slot_on_1d_mesh(tmp_path):
+    """m=1 (a 1-D mesh) is a legal sharded save: one shard file, same
+    read paths — the format does not require tensor parallelism."""
+    mesh = make_mesh(4)
+    params = {"w": jax.device_put(np.arange(8, dtype=np.float32))}
+    p = str(tmp_path / "ck.pt")
+    sha, names = save_checkpoint_sharded(
+        p, params, {}, SGDState({"w": jnp.zeros(8)}), 1, 0, mesh=mesh)
+    assert len(names) == 1 and sha
+    ck = load_checkpoint(p)
+    np.testing.assert_array_equal(np.asarray(ck.params["w"]),
+                                  np.arange(8, dtype=np.float32))
+    ck2 = load_for_mesh(p, make_mesh(8))
+    np.testing.assert_array_equal(_flat(ck2.params), _flat(params))
+
+
+def test_data_sharded_leaf_refused(tmp_path):
+    from jax.sharding import NamedSharding
+    mesh = make_mesh(shape=(2, 4))
+    bad = jax.device_put(np.zeros((8, 4), np.float32),
+                         NamedSharding(mesh, P("data")))
+    with pytest.raises(ValueError, match="data axis"):
+        save_checkpoint_sharded(str(tmp_path / "ck.pt"), {"w": bad}, {},
+                                SGDState({"w": bad}), 0, 0, mesh=mesh)
+
+
+# -- shard verification errors ---------------------------------------------
+
+
+def test_torn_and_missing_shard_raise_named_errors(tmp_path, tp_state):
+    mesh, plan, state = tp_state
+    p = str(tmp_path / "ck.pt")
+    _, names = save_checkpoint_sharded(p, state.params, state.batch_stats,
+                                       state.opt_state, 7, 3, mesh=mesh)
+    # Torn shard: sha mismatch detected BEFORE any assembly.
+    victim = str(tmp_path / names[2])
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+    with pytest.raises(CheckpointError, match="shard.*mismatch|torn"):
+        load_checkpoint(p)
+    with pytest.raises(CheckpointError, match="shard"):
+        load_for_mesh(p, make_mesh(8))
+    # Missing shard: named, not a KeyError.
+    os.unlink(victim)
+    with pytest.raises(CheckpointError, match="MISSING"):
+        load_checkpoint(p)
+    # Torn INDEX: same failure mode as a torn v1 head.
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(CheckpointError, match="torn|not a readable"):
+        load_checkpoint(p)
+
+
+def test_future_format_version_refused_by_both_readers(tmp_path):
+    """A v3 file must fail as 'upgrade ddp_tpu' on BOTH entry points —
+    load_checkpoint AND the production --resume/serve path
+    (load_for_mesh -> read_shard_index) — never restore under v2
+    assumptions or misreport as damage."""
+    p = str(tmp_path / "ck.pt")
+    np.savez(open(p, "wb"),
+             **{"meta/format_version": np.asarray(3, np.int64),
+                "meta/step": np.asarray(0, np.int64),
+                "meta/epoch": np.asarray(0, np.int64)})
+    with pytest.raises(CheckpointError, match="upgrade"):
+        load_checkpoint(p)
+    with pytest.raises(CheckpointError, match="upgrade"):
+        read_shard_index(p)
+    with pytest.raises(CheckpointError, match="upgrade"):
+        load_for_mesh(p, make_mesh(8))
+
+
+def test_separator_key_refused_at_sharded_save(tmp_path):
+    """checkpoint._flatten's '/'-guard carries over: a '/'-containing
+    model key fails LOUDLY at save time instead of silently saving a
+    tree that _unflatten would rebuild differently on restore."""
+    mesh = make_mesh(shape=(2, 4))
+    w = jnp.zeros(8)
+    with pytest.raises(ValueError, match="contains '/'"):
+        save_checkpoint_sharded(str(tmp_path / "ck.pt"), {"a/b": w}, {},
+                                SGDState({"a/b": w}), 0, 0, mesh=mesh)
+
+
+def test_load_for_mesh_spec_drift_is_named(tmp_path, tp_state):
+    mesh, plan, state = tp_state
+    p = str(tmp_path / "ck.pt")
+    save_checkpoint_sharded(p, state.params, state.batch_stats,
+                            state.opt_state, 7, 3, mesh=mesh)
+    with pytest.raises(CheckpointError, match="drifted"):
+        load_for_mesh(p, mesh, param_specs={"not": {"the": P()}})
+
+
+# -- lineage shard-set bookkeeping -----------------------------------------
+
+
+def test_lineage_trims_dropped_epochs_shards(tmp_path, tp_state):
+    """keep=2: the head's and the retained epoch's shard sets both
+    survive rotation; committing a third epoch unlinks exactly the
+    dropped epoch's shards (and never a referenced one)."""
+    mesh, plan, state = tp_state
+    p = str(tmp_path / "ck.pt")
+    lin = CheckpointLineage(p, keep=2)
+
+    def save(epoch):
+        lin.preserve_head()
+        sha, names = save_checkpoint_sharded(
+            p, state.params, state.batch_stats, state.opt_state,
+            epoch * 10, epoch, mesh=mesh)
+        lin.commit(epoch=epoch, step=epoch * 10, sha256=sha, shards=names)
+        return names
+
+    n0, n1 = save(0), save(1)
+    assert all(os.path.exists(str(tmp_path / n)) for n in n0 + n1)
+    man = json.load(open(p + ".manifest.json"))
+    assert man["head"]["shards"] == n1
+    assert man["retained"][0]["shards"] == n0
+    n2 = save(2)  # epoch 0 drops out of retention
+    assert all(not os.path.exists(str(tmp_path / n)) for n in n0)
+    assert all(os.path.exists(str(tmp_path / n)) for n in n1 + n2)
+    # The retained epoch-1 snapshot still RESTORES through its rotated
+    # index (the epoch-qualified shard names made rotation free).
+    ck = load_checkpoint(str(tmp_path / "ck.pt.ep00000001"))
+    assert ck.epoch == 1
+    # Same-epoch re-commit (a resumed run): overwrites in place, shards
+    # keep their names, nothing referenced is unlinked.
+    n2b = save(2)
+    assert n2b == n2
+    assert all(os.path.exists(str(tmp_path / n)) for n in n2)
+
+
+def test_lineage_scan_skips_shard_files(tmp_path, tp_state):
+    """Manifest-less directory scan: ``P.ep*`` restore candidates are the
+    rotated INDEX files only — never the sharded data files that share
+    the prefix."""
+    from ddp_tpu.resilience.lineage import _candidates
+    mesh, plan, state = tp_state
+    p = str(tmp_path / "ck.pt")
+    lin = CheckpointLineage(p, keep=2)
+    for epoch in (0, 1):
+        lin.preserve_head()
+        sha, names = save_checkpoint_sharded(
+            p, state.params, state.batch_stats, state.opt_state,
+            epoch, epoch, mesh=mesh)
+        lin.commit(epoch=epoch, step=epoch, sha256=sha, shards=names)
+    os.unlink(p + ".manifest.json")
+    cands = [os.path.basename(fp) for fp, _ in _candidates(p)]
+    assert cands == ["ck.pt", "ck.pt.ep00000000"]
+
+
+# -- spec plumbing ---------------------------------------------------------
+
+
+def test_spec_json_round_trip():
+    for spec in (P(), P(None, MODEL_AXIS), P(MODEL_AXIS),
+                 P(None, None, None, MODEL_AXIS),
+                 P(("data", "model"), None)):
+        entries = spec_to_json(spec)
+        json.dumps(entries)  # must be JSON-serializable as-is
+        assert spec_from_json(entries) == spec
+    assert spec_from_json(None) == P()

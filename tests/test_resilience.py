@@ -177,7 +177,8 @@ def test_rotation_never_touches_unlisted_or_inflight_files(tmp_path):
 
 
 def _make_trainer(path, epochs, seed=0, resume=False, keep=1,
-                  on_nan="abort", preemption=None, save_every=1):
+                  on_nan="abort", preemption=None, save_every=1,
+                  ckpt_format="gathered"):
     """test_checkpoint.py's DeepNN trainer, resilience knobs exposed."""
     train_ds, _ = synthetic(n_train=256, seed=1)
     mesh = make_mesh(8)
@@ -191,7 +192,7 @@ def _make_trainer(path, epochs, seed=0, resume=False, keep=1,
                    sgd_config=SGDConfig(lr=0.05), save_every=save_every,
                    snapshot_path=path, resume=resume,
                    keep_checkpoints=keep, on_nan=on_nan,
-                   preemption=preemption)
+                   preemption=preemption, ckpt_format=ckpt_format)
 
 
 def _params_equal(a, b):
@@ -223,6 +224,73 @@ def test_resume_falls_back_on_torn_head(tmp_path, capfd):
     with pytest.raises(CheckpointError) as ei:
         _make_trainer(path, epochs=3, keep=2, resume=True)
     assert "ck.pt" in str(ei.value) and "ep00000001" in str(ei.value)
+
+
+def test_sharded_resume_falls_back_on_torn_shard(tmp_path, capfd):
+    """ISSUE 6: the sharded (v2) format keeps the lineage fallback
+    semantics — a TORN SHARD FILE (head index intact, shard sha256
+    mismatch) fails that candidate with the shard named and resume falls
+    back to the previous retained snapshot, exactly like a torn v1 head."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=3, keep=2, ckpt_format="sharded")
+    tr.train(2)
+    shards1 = [n for n in os.listdir(tmp_path) if ".ep00000001.shard" in n
+               and n.endswith(".npz")]
+    assert shards1, "sharded save wrote no epoch-1 shard files"
+    faults.tear_file(str(tmp_path / shards1[0]))
+    res = _make_trainer(path, epochs=3, keep=2, resume=True,
+                        ckpt_format="sharded")
+    err = capfd.readouterr().err
+    assert "FALLBACK" in err
+    assert res.start_epoch == 1  # fell back to the epoch-0 snapshot
+    res.train(3)  # ...and the run continues to completion
+    assert int(res.state.step) == 3 * len(res.train_loader)
+
+
+def test_sharded_resume_falls_back_on_missing_shard(tmp_path, capfd):
+    """A MISSING shard file (deleted/never-landed) is the other v2 damage
+    mode: the candidate fails naming the absent shard, the walk falls
+    back; with EVERY epoch's shard set damaged, resume raises naming each
+    candidate tried."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=3, keep=2, ckpt_format="sharded")
+    tr.train(2)
+    shards1 = [n for n in os.listdir(tmp_path) if ".ep00000001.shard" in n
+               and n.endswith(".npz")]
+    assert shards1
+    os.unlink(str(tmp_path / shards1[0]))
+    res = _make_trainer(path, epochs=3, keep=2, resume=True,
+                        ckpt_format="sharded")
+    err = capfd.readouterr().err
+    assert "FALLBACK" in err and "MISSING" in err
+    assert res.start_epoch == 1
+    # Now damage the fallback too: every candidate fails, loudly.
+    for n in os.listdir(tmp_path):
+        if ".ep00000000.shard" in n and n.endswith(".npz"):
+            os.unlink(str(tmp_path / n))
+    with pytest.raises(CheckpointError) as ei:
+        _make_trainer(path, epochs=3, keep=2, resume=True,
+                      ckpt_format="sharded")
+    assert "ck.pt" in str(ei.value) and "ep00000000" in str(ei.value)
+
+
+def test_sharded_lineage_trims_dropped_epochs_shards(tmp_path):
+    """Retention composes with the shard set: when an epoch drops out of
+    the manifest its shard files are unlinked with it — and never one a
+    surviving entry still references (the rotated head's epoch-qualified
+    shards stay restorable)."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=3, keep=2, ckpt_format="sharded")
+    tr.train(3)
+    names = os.listdir(tmp_path)
+    assert not [n for n in names if ".ep00000000.shard" in n], \
+        "dropped epoch 0's shard files were not trimmed"
+    assert [n for n in names if ".ep00000001.shard" in n], \
+        "retained epoch 1's shard files were trimmed"
+    assert [n for n in names if ".ep00000002.shard" in n]
+    # The head and the retained rotated snapshot both still restore.
+    assert load_checkpoint(path).epoch == 2
+    assert load_checkpoint(str(tmp_path / "ck.pt.ep00000001")).epoch == 1
 
 
 def test_on_nan_abort_raises_and_head_stays_good(tmp_path):
