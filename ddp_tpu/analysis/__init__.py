@@ -1,0 +1,34 @@
+"""Program auditor: static analysis over the programs this framework
+actually runs.
+
+Three detector families behind one findings model and one CLI
+(``python -m ddp_tpu.analysis``, see ``__main__.py``):
+
+- **jaxpr auditors** (``jaxpr_audit``) — trace every registered program
+  (``programs.REGISTRY``) abstractly and check its collective inventory
+  against declarative invariants (gradient psums on ``data`` only, TP
+  psums on ``model`` matching the plan's expected counts, zero model-axis
+  all_gathers, collective-free serve forwards, the ZeRO
+  reduce_scatter/all_gather pair), plus constant-capture and donation
+  checks on the same trace.
+- **host-sync pass** (``hostsync``) — AST scan of ``train/``, ``data/``,
+  ``serve/`` for device->host transfers inside step/epoch loops.
+- **lockset lint** (``lockset``) — AST-derived shared-attribute access
+  sets vs declared lock scopes in the threaded subsystems, with the
+  ``# analysis: shared-under(...)`` / ``unlocked-ok(...)`` /
+  ``host-sync-ok(...)`` annotation vocabulary as the audit trail.
+
+``fixtures`` holds one seeded-faulty program per detector — the
+auditor's own regression suite.
+"""
+from .findings import (Finding, SEVERITIES, count_by_severity,  # noqa: F401
+                       format_table, make_finding)
+from .jaxpr_audit import (COLLECTIVE_PRIMITIVES,  # noqa: F401
+                          audit_collectives, audit_constants,
+                          audit_donation, collective_inventory,
+                          inventory_as_json, trace_jaxpr)
+from .hostsync import scan_packages  # noqa: F401
+from .lockset import scan_modules  # noqa: F401
+from .programs import (REGISTRY, BuiltProgram, ProgramSpec,  # noqa: F401
+                       build_context, build_programs, program_names)
+from .fixtures import FIXTURES, fixture_names, run_fixture  # noqa: F401

@@ -1,0 +1,171 @@
+"""``python -m ddp_tpu.analysis`` — the program auditor CLI.
+
+Runs, against the registered head programs (``analysis/programs.py``) on
+a virtual mesh:
+
+1. the jaxpr collective auditor (axis/count invariants per program),
+2. the constant-capture and donation checks,
+3. the host-sync AST pass over ``train/``, ``data/``, ``serve/``,
+4. the lockset lint over the threaded subsystems,
+
+prints one findings table, optionally writes the JSON artifact CI
+uploads, and with ``--strict`` exits nonzero on any ``error`` finding —
+the CI gate.  ``--fixture <name>`` runs one seeded-faulty fixture
+instead (every error-level fixture must fail ``--strict``; that is
+tested).  Tracing is abstract: no XLA compile, no device memory — the
+full default registry audits in seconds on one CPU process.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _parse(argv: Optional[List[str]]) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.analysis",
+        description="Audit the registered SPMD programs and threaded "
+                    "runtime before a chip run.")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero when any error-severity finding "
+                        "is reported (the CI gate)")
+    p.add_argument("--json", metavar="PATH",
+                   help="write the findings + per-program collective "
+                        "inventories as a JSON artifact")
+    p.add_argument("--programs", metavar="A,B,...",
+                   help="comma-separated registry names to audit "
+                        "(default: every program the model supports)")
+    p.add_argument("--model", default=None,
+                   help="model to build programs for (default: the "
+                        "registry default, deepnn)")
+    p.add_argument("--mesh-shape", "--mesh_shape", dest="mesh_shape",
+                   default=None, metavar="D,M",
+                   help="(data, model) mesh shape, default 2,4; the 1-D "
+                        "programs use all D*M devices")
+    p.add_argument("--fixture", metavar="NAME",
+                   help="run one seeded-faulty fixture instead of the "
+                        "registry (see --list)")
+    p.add_argument("--skip-programs", action="store_true",
+                   help="skip the jaxpr auditors (static passes only)")
+    p.add_argument("--skip-static", action="store_true",
+                   help="skip the host-sync and lockset passes")
+    p.add_argument("--list", action="store_true",
+                   help="list registered programs and fixtures, exit")
+    return p.parse_args(argv)
+
+
+def _prepare_backend(num_devices: int) -> None:
+    """Trace-only audit: default to the CPU backend with enough virtual
+    devices for the requested mesh.  Must run before jax's backend
+    initializes; explicit user env always wins."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{num_devices}").strip()
+
+
+def _mesh_shape(arg: Optional[str]):
+    from .programs import DEFAULT_MESH_2D
+    if not arg:
+        return DEFAULT_MESH_2D
+    parts = [int(v) for v in arg.replace("x", ",").split(",") if v]
+    if len(parts) != 2 or min(parts) < 1:
+        raise SystemExit(f"--mesh-shape wants D,M (got {arg!r})")
+    return tuple(parts)
+
+
+def _inventory_summary(inv) -> str:
+    if not inv:
+        return "collective-free"
+    return ", ".join(f"{prim}({','.join(axes) or '-'}) x{n}"
+                     for (prim, axes), n in sorted(inv.items()))
+
+
+def run(argv: Optional[List[str]] = None,
+        out=None) -> int:
+    args = _parse(argv)
+    out = out or sys.stdout
+
+    if args.list:
+        from .fixtures import fixture_names
+        from .programs import program_names
+        print("programs:", file=out)
+        for name in program_names():
+            print(f"  {name}", file=out)
+        print("fixtures:", file=out)
+        for name in fixture_names():
+            print(f"  {name}", file=out)
+        return 0
+
+    mesh_shape = _mesh_shape(args.mesh_shape)
+    _prepare_backend(mesh_shape[0] * mesh_shape[1])
+
+    from .findings import count_by_severity, format_table, make_finding
+
+    findings = []
+    inventories = {}
+
+    if args.fixture:
+        from .fixtures import run_fixture
+        findings.extend(run_fixture(args.fixture))
+    else:
+        if not args.skip_programs:
+            from .jaxpr_audit import (audit_collectives, audit_constants,
+                                      audit_donation, collective_inventory,
+                                      inventory_as_json, trace_jaxpr)
+            from .programs import (DEFAULT_MODEL, build_context,
+                                   build_programs)
+            names = ([n.strip() for n in args.programs.split(",")
+                      if n.strip()] if args.programs else None)
+            ctx = build_context(args.model or DEFAULT_MODEL,
+                                mesh_2d=mesh_shape)
+            for prog in build_programs(ctx, names):
+                closed = trace_jaxpr(prog.fn, prog.args)
+                inv = collective_inventory(closed)
+                inventories[prog.name] = inventory_as_json(inv)
+                findings.append(make_finding(
+                    "info", "inventory", prog.name,
+                    _inventory_summary(inv)))
+                findings.extend(audit_collectives(
+                    prog.name, prog.kind, inv, plan=prog.plan,
+                    zero=prog.zero))
+                findings.extend(audit_constants(prog.name, closed))
+                findings.extend(audit_donation(
+                    prog.name, prog.kind, prog.fn, prog.args))
+        if not args.skip_static:
+            from .hostsync import scan_packages
+            from .lockset import scan_modules
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            findings.extend(scan_packages(pkg_root))
+            findings.extend(scan_modules(pkg_root))
+
+    print(format_table(findings), file=out)
+    counts = count_by_severity(findings)
+
+    if args.json:
+        artifact = {"counts": counts,
+                    "findings": [f.as_json() for f in findings],
+                    "inventories": inventories,
+                    "mesh_shape": list(mesh_shape)}
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(artifact, fh, indent=2, sort_keys=True)
+        print(f"wrote {args.json}", file=out)
+
+    if args.strict and counts["error"]:
+        print(f"--strict: {counts['error']} error finding(s)", file=out)
+        return 1
+    return 0
+
+
+def main() -> None:
+    raise SystemExit(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,158 @@
+"""AST pass for implicit host syncs inside step/epoch loops.
+
+JAX dispatch is asynchronous: the step loop stays ahead of the device
+precisely as long as nothing in the loop body forces a device->host
+transfer.  One ``jax.device_get`` (or a ``float()`` on a device scalar,
+or ``np.asarray`` on a device array) inside the hot loop serializes every
+iteration on the previous step's completion — the classic silent 2x.
+This pass walks ``train/``, ``data/``, ``serve/`` and flags, inside any
+``for``/``while`` body:
+
+- ``jax.device_get(...)`` / bare ``device_get(...)`` — always a sync;
+- ``float(x)`` / ``int(x)`` / ``x.item()`` / ``np.asarray(x)`` /
+  ``np.array(x)`` where ``x`` was assigned IN THE SAME LOOP BODY from a
+  call whose name ends in ``step``/``forward``/``apply``/``fwd`` — the
+  device-value dataflow we can prove statically (the trainer's
+  ``state, loss = self.train_step(...)`` shape) without drowning the
+  report in false positives on host arrays.
+
+Deliberate syncs (an epoch-boundary flush, a d2h span in the serve
+pipeline) carry the annotation ``# analysis: host-sync-ok(<reason>)`` on
+the statement line or the line above; the annotation is the audit trail
+that someone DECIDED the sync is off the hot path.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator, List, Tuple
+
+from .findings import Finding, make_finding
+
+SCAN_PACKAGES = ("train", "data", "serve")
+DEVICE_PRODUCER_SUFFIXES = ("step", "forward", "apply", "fwd")
+_OK_RE = re.compile(r"#\s*analysis:\s*host-sync-ok\(([^)]*)\)")
+
+
+def _annotated_ok(lines: List[str], lineno: int) -> bool:
+    """True when line ``lineno`` (1-based) or the line above carries the
+    host-sync-ok annotation."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines) and _OK_RE.search(lines[ln - 1]):
+            return True
+    return False
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, '' when not a plain name/attr."""
+    parts: List[str] = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def _is_device_get(name: str) -> bool:
+    return name.endswith("device_get")
+
+
+def _is_host_cast(name: str) -> bool:
+    return name in ("float", "int") or name.endswith((".item",
+                                                      "np.asarray",
+                                                      "np.array",
+                                                      "numpy.asarray",
+                                                      "numpy.array"))
+
+
+def _assigned_names(node: ast.AST) -> Iterator[str]:
+    for t in ast.walk(node):
+        if isinstance(t, ast.Name) and isinstance(t.ctx, ast.Store):
+            yield t.id
+
+
+def _loops(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.While)):
+            yield node
+
+
+def _device_names_in_loop(loop: ast.AST) -> set:
+    """Names assigned inside this loop body from a device-producing call
+    (``state, loss = self.train_step(...)``)."""
+    names: set = set()
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Call):
+            callee = _call_name(node.value)
+            last = callee.rsplit(".", 1)[-1]
+            if last.endswith(DEVICE_PRODUCER_SUFFIXES):
+                for tgt in node.targets:
+                    names.update(_assigned_names(tgt))
+    return names
+
+
+def scan_source(path: str, source: str) -> List[Finding]:
+    """Host-sync findings for one module's source text."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [make_finding("warning", "host-sync", path,
+                             f"unparseable: {e}")]
+    lines = source.splitlines()
+    out: List[Finding] = []
+    seen: set = set()
+    for loop in _loops(tree):
+        device_names = _device_names_in_loop(loop)
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or node.lineno in seen:
+                continue
+            name = _call_name(node)
+            where = f"{path}:{node.lineno}"
+            if _is_device_get(name):
+                if not _annotated_ok(lines, node.lineno):
+                    seen.add(node.lineno)
+                    out.append(make_finding(
+                        "error", "host-sync", where,
+                        f"{name}() inside a loop — a device->host sync "
+                        "per iteration serializes the step loop on device "
+                        "completion; hoist it past the loop (or annotate "
+                        "'# analysis: host-sync-ok(reason)' if it is "
+                        "deliberately off the hot path)"))
+            elif _is_host_cast(name) and node.args:
+                arg = node.args[0]
+                if (isinstance(arg, ast.Name)
+                        and arg.id in device_names
+                        and not _annotated_ok(lines, node.lineno)):
+                    seen.add(node.lineno)
+                    out.append(make_finding(
+                        "error", "host-sync", where,
+                        f"{name}({arg.id}) inside a loop, on a value "
+                        "produced by a jitted step/forward in the same "
+                        "loop body — an implicit per-iteration device "
+                        "sync; keep it on device (append the raw value) "
+                        "and read the batch once after the loop"))
+    return out
+
+
+def scan_packages(root: str,
+                  packages: Tuple[str, ...] = SCAN_PACKAGES
+                  ) -> List[Finding]:
+    """Walk the given subpackages of the ddp_tpu package root."""
+    out: List[Finding] = []
+    for pkg in packages:
+        pkg_dir = os.path.join(root, pkg)
+        if not os.path.isdir(pkg_dir):
+            continue
+        for dirpath, _dirs, files in os.walk(pkg_dir):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                fpath = os.path.join(dirpath, fname)
+                rel = os.path.relpath(fpath, os.path.dirname(root))
+                with open(fpath, "r", encoding="utf-8") as fh:
+                    out.extend(scan_source(rel, fh.read()))
+    return out

@@ -1,0 +1,212 @@
+"""The audited-program registry: every SPMD program family, buildable on
+a virtual mesh, with its declarative invariants.
+
+One entry per (program family x mesh regime): the 1-D data-parallel
+train/accum/ZeRO steps, their (d, m) tensor-parallel variants, the
+evaluation step, and the serve forward — the complete set of programs a
+chip run executes (train/step.py, train/zero.py, serve/engine.py).  Each
+entry builds the REAL head builder's jitted function plus abstract
+(``ShapeDtypeStruct``) example arguments, so auditing traces the exact
+program the trainer runs, never a reimplementation — and tracing abstract
+args costs no device memory and no XLA compile.
+
+The registry is tiny on purpose: entries are (name, kind, zero, tp,
+build), invariants derive from (kind, zero, plan) in
+``jaxpr_audit.audit_collectives``.  ``kind``:
+
+- ``update``  — optimizer steps: data-axis grad reduction required, full
+  state donation required, ZeRO pair iff ``zero``.
+- ``forward`` — the serve logits program: collective-free off (and, here,
+  on) the data axis.
+- ``eval``    — the counter-psum evaluation step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+DEFAULT_MODEL = "deepnn"
+DEFAULT_MESH_2D = (2, 4)
+_BATCH = 32      # global rows per step for the audit trace
+_ACCUM = 2       # micro-batches for the accum variants
+
+
+class BuiltProgram(NamedTuple):
+    name: str
+    kind: str                 # "update" | "forward" | "eval"
+    zero: bool
+    fn: Any                   # the jitted callable (head builder output)
+    args: Tuple               # abstract example args for make_jaxpr/lower
+    plan: Optional[Any]       # TPPlan when tensor-parallel, else None
+
+
+class ProgramSpec(NamedTuple):
+    name: str
+    kind: str
+    zero: bool
+    tp: bool
+    build: Callable[["_Ctx", str], BuiltProgram]
+
+
+class _Ctx(NamedTuple):
+    """Shared build context: model + meshes + abstract state, built once
+    per audit run (model init is the only concrete computation)."""
+    model: Any
+    mesh1d: Any
+    mesh2d: Any
+    plan: Optional[Any]
+    params: Any
+    stats: Any
+
+
+def _sds(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)),
+        tree)
+
+
+def _batch(stacked: bool = False):
+    shape = (_ACCUM, _BATCH) if stacked else (_BATCH,)
+    return {"image": jax.ShapeDtypeStruct(shape + (32, 32, 3), jnp.uint8),
+            "label": jax.ShapeDtypeStruct(shape, jnp.int32)}
+
+
+def _eval_batch():
+    b = _batch()
+    b["mask"] = jax.ShapeDtypeStruct((_BATCH,), jnp.bool_)
+    return b
+
+
+def _rng():
+    return _sds(jax.random.key(0))
+
+
+def _sgd():
+    from ..optim import SGDConfig, triangular_lr
+    return SGDConfig(lr=0.1), functools.partial(
+        triangular_lr, base_lr=0.1, num_epochs=2, steps_per_epoch=4)
+
+
+def _train_state(ctx: _Ctx, mesh, *, zero: bool, plan):
+    from ..train.step import init_train_state
+    state = init_train_state(ctx.params, ctx.stats)
+    if zero:
+        from ..train.zero import init_opt_shard
+        state = state._replace(
+            opt_state=init_opt_shard(state.params, mesh, plan=plan))
+    return _sds(state)
+
+
+def _build_step(ctx: _Ctx, name: str, *, accum: bool, zero: bool,
+                tp: bool) -> BuiltProgram:
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    cfg, sched = _sgd()
+    if zero:
+        from ..train.zero import (make_train_step_zero,
+                                  make_train_step_zero_accum)
+        builder = make_train_step_zero_accum if accum else \
+            make_train_step_zero
+    else:
+        from ..train.step import make_train_step, make_train_step_accum
+        builder = make_train_step_accum if accum else make_train_step
+    fn = builder(ctx.model, cfg, sched, mesh, plan=plan)
+    state = _train_state(ctx, mesh, zero=zero, plan=plan)
+    return BuiltProgram(name, "update", zero, fn,
+                        (state, _batch(stacked=accum), _rng()), plan)
+
+
+def _build_eval(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
+    from ..train.step import make_eval_step
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    fn = make_eval_step(ctx.model, mesh, plan=plan)
+    return BuiltProgram(name, "eval", False, fn,
+                        (_sds(ctx.params), _sds(ctx.stats), _eval_batch()),
+                        plan)
+
+
+def _build_forward(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
+    from ..train.step import make_eval_forward
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    fn = make_eval_forward(ctx.model, mesh, plan=plan)
+    images = jax.ShapeDtypeStruct((_BATCH, 32, 32, 3), jnp.uint8)
+    return BuiltProgram(name, "forward", False, fn,
+                        (_sds(ctx.params), _sds(ctx.stats), images), plan)
+
+
+def _spec(name, kind, *, zero=False, tp=False, accum=False) -> ProgramSpec:
+    if kind == "update":
+        build = functools.partial(_build_step, accum=accum, zero=zero,
+                                  tp=tp)
+    elif kind == "eval":
+        build = functools.partial(_build_eval, tp=tp)
+    else:
+        build = functools.partial(_build_forward, tp=tp)
+    return ProgramSpec(name, kind, zero, tp, build)
+
+
+# The default registry — all of it traces in seconds; names are stable
+# CLI/JSON keys (``--programs`` selects by them).
+REGISTRY: Tuple[ProgramSpec, ...] = (
+    _spec("train_step@dp8", "update"),
+    _spec("train_step_accum@dp8", "update", accum=True),
+    _spec("train_step_zero@dp8", "update", zero=True),
+    _spec("train_step_zero_accum@dp8", "update", zero=True, accum=True),
+    _spec("train_step@tp", "update", tp=True),
+    _spec("train_step_accum@tp", "update", tp=True, accum=True),
+    _spec("train_step_zero@tp", "update", zero=True, tp=True),
+    _spec("eval_step@dp8", "eval"),
+    _spec("eval_step@tp", "eval", tp=True),
+    _spec("serve_forward@dp8", "forward"),
+    _spec("serve_forward@tp", "forward", tp=True),
+)
+
+
+def program_names() -> List[str]:
+    return [s.name for s in REGISTRY]
+
+
+def build_context(model_name: str = DEFAULT_MODEL,
+                  mesh_2d: Tuple[int, int] = DEFAULT_MESH_2D) -> _Ctx:
+    """Meshes + model + plan, shared by every registry build.  The 1-D
+    mesh spans d*m devices so both regimes audit the same device budget
+    (CI: the (2,4)x8 virtual mesh)."""
+    from ..models import get_model
+    from ..parallel.mesh import make_mesh
+    d, m = mesh_2d
+    model = get_model(model_name)
+    params, stats = model.init(jax.random.key(0))
+    mesh1d = make_mesh(d * m)
+    mesh2d = make_mesh(shape=(d, m))
+    plan = None
+    if m > 1:
+        from ..parallel.tp.plan import plan_for_model
+        try:
+            plan = plan_for_model(model_name, params, stats, model_size=m)
+        except ValueError:
+            plan = None  # model without a recipe: tp entries are skipped
+    return _Ctx(model, mesh1d, mesh2d, plan, params, stats)
+
+
+def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
+    """Build the selected registry entries (default: every entry the
+    context supports — tp entries are skipped when the model has no
+    TP_RECIPE/plan)."""
+    wanted = set(names) if names else None
+    unknown = (wanted or set()) - set(program_names())
+    if unknown:
+        raise ValueError(f"unknown program(s) {sorted(unknown)}; "
+                         f"registry has {program_names()}")
+    out = []
+    for spec in REGISTRY:
+        if wanted is not None and spec.name not in wanted:
+            continue
+        if spec.tp and ctx.plan is None:
+            continue
+        out.append(spec.build(ctx, spec.name))
+    return out

@@ -235,6 +235,14 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                    help="Pin steps_per_epoch in the LR schedule (the "
                         "reference hardcodes 98/49, multigpu.py:137; "
                         "default: derived from the real shard size)")
+    p.add_argument("--audit", action="store_true",
+                   help="Pre-flight: run the program auditor (python -m "
+                        "ddp_tpu.analysis --strict) over the registered "
+                        "program families for this --model and mesh shape "
+                        "before training — collective axes/counts vs the "
+                        "TP plan, donation, constant capture, plus the "
+                        "host-sync and lockset lints — and abort on any "
+                        "error finding (RUNBOOK.md section 12)")
     return p
 
 
@@ -289,7 +297,30 @@ def main(args: argparse.Namespace, *, num_devices: Optional[int]) -> None:
     the backstop against any recursion."""
     if args.spawn and "DDP_TPU_PROCESS_ID" not in os.environ:
         raise SystemExit(spawn_local(args.spawn))
+    if args.audit and "DDP_TPU_PROCESS_ID" not in os.environ:
+        _preflight_audit(args)
     run(args, num_devices=num_devices)
+
+
+def _preflight_audit(args: argparse.Namespace) -> None:
+    """``--audit``: trace-audit the program families this run will build
+    BEFORE any device state exists (ddp_tpu/analysis).  Tracing is
+    abstract, so the cost is seconds; an error finding (wrong-axis
+    collective, missing donation, captured constant, lockset/host-sync
+    violation) aborts the run here instead of wasting a chip
+    reservation."""
+    from .analysis.__main__ import run as audit_run
+    if args.mesh_shape:
+        shape = str(args.mesh_shape)
+    else:
+        import jax  # backend decides the 1-D width, same as run() will
+        shape = f"{args.num_devices or jax.device_count()},1"
+    rc = audit_run(["--strict", "--model", args.model,
+                    "--mesh-shape", shape])
+    if rc:
+        raise SystemExit(
+            f"--audit: program auditor reported error findings (exit {rc});"
+            " fix them or drop --audit to proceed at your own risk")
 
 
 def _load_torch_init(model_name: str, path: str):

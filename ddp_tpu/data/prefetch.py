@@ -72,10 +72,10 @@ class PrefetchStats:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self.host_s = 0.0
-        self.h2d_s = 0.0
-        self.wait_s = 0.0
-        self.batches = 0
+        self.host_s = 0.0   # analysis: shared-under(_lock)
+        self.h2d_s = 0.0    # analysis: shared-under(_lock)
+        self.wait_s = 0.0   # analysis: shared-under(_lock)
+        self.batches = 0    # analysis: shared-under(_lock)
 
     def _add(self, field: str, dt: float) -> None:
         with self._lock:
@@ -86,11 +86,17 @@ class PrefetchStats:
             self.batches += 1
 
     def per_step_ms(self) -> Dict[str, float]:
-        n = max(self.batches, 1)
-        return {"host_ms_per_step": round(self.host_s / n * 1e3, 3),
-                "h2d_enqueue_ms_per_step": round(self.h2d_s / n * 1e3, 3),
-                "consumer_wait_ms_per_step": round(self.wait_s / n * 1e3, 3),
-                "batches": self.batches}
+        # Under the lock: pool workers are still adding while the epoch
+        # summary reads, and the numbers must be one consistent snapshot
+        # (a torn host_s/batches pair misattributes the bubble).
+        with self._lock:
+            n = max(self.batches, 1)
+            return {"host_ms_per_step": round(self.host_s / n * 1e3, 3),
+                    "h2d_enqueue_ms_per_step":
+                        round(self.h2d_s / n * 1e3, 3),
+                    "consumer_wait_ms_per_step":
+                        round(self.wait_s / n * 1e3, 3),
+                    "batches": self.batches}
 
 
 def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,

@@ -41,6 +41,13 @@ TP_RECIPE = {
     "classifier/linear1": "row",
 }
 
+# The layer consuming the NETWORK INPUT.  Declared (not inferred) because
+# the plan's expected-collectives accounting needs it: a train step takes
+# gradients w.r.t. params only, so the stem's column-style input-gradient
+# psum is dead code and XLA-free jaxpr tracing already omits it
+# (parallel/tp/plan.py:expected_collectives, ddp_tpu/analysis/).
+TP_STEM = "features/conv0"
+
 Params = Dict[str, Any]
 
 

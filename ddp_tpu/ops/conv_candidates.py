@@ -37,7 +37,6 @@ evidence row, win or negative result.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 
 import jax

@@ -28,7 +28,13 @@ cotangent.  The runtime's own psum transpose is another psum — correct for
 varying cotangents, but a silent ``model``-axis-size overcount for the
 replicated ones every row-parallel layer produces (and each row layer on
 the path would multiply again).  The m=1 bit-identity and 1-D-parity tests
-(tests/test_tp.py) pin this numerically.
+(tests/test_tp.py) pin this numerically, and the program auditor pins it
+structurally: ``python -m ddp_tpu.analysis`` counts the traced
+``psum(model)`` equations against the plan's expected-collectives
+arithmetic (row layers psum in the forward, column layers in the
+backward, the stem's input-grad psum elided — plan.py
+``expected_collectives``), so an extra or missing model-axis collective
+fails CI before it costs ICI bandwidth.
 """
 from __future__ import annotations
 

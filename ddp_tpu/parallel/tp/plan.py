@@ -27,7 +27,7 @@ tests/test_tp.py.
 from __future__ import annotations
 
 import importlib
-from typing import Any, Dict, List, NamedTuple, Tuple
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -48,6 +48,14 @@ class TPPlan(NamedTuple):
     param_specs: Any   # pytree of PartitionSpec, same structure as params
     stats_specs: Any   # pytree of PartitionSpec for batch_stats (replicated)
     rows: Tuple       # ((path, style, shape, spec), ...) in table order
+    # ((layer path, style), ...) in RECIPE (network) order — the per-layer
+    # view the expected-collectives accounting needs (rows are per-leaf and
+    # alphabetical, which is neither).
+    layers: Tuple = ()
+    # The recipe's declared stem (the layer consuming the network input),
+    # whose column-style input-gradient psum is dead-code-eliminated in any
+    # params-only backward — see expected_collectives.
+    stem: Optional[str] = None
 
 
 def _recipe_for(model_name: str) -> Dict[str, str]:
@@ -64,7 +72,12 @@ def _recipe_for(model_name: str) -> Dict[str, str]:
     if bad:
         raise ValueError(f"unknown TP styles {bad} in {model_name}'s "
                          f"TP_RECIPE; expected one of {STYLES}")
-    return recipe
+    stem = getattr(mod, "TP_STEM", None)
+    if stem is not None and stem not in recipe:
+        raise ValueError(
+            f"{model_name}'s TP_STEM {stem!r} is not a TP_RECIPE rule; "
+            f"the stem must name one of {list(recipe)}")
+    return recipe, stem
 
 
 def _walk(tree: Any, prefix: str, out: List[Tuple[str, Any]]) -> None:
@@ -98,7 +111,7 @@ def plan_for_model(model_name: str, params, batch_stats=None, *,
     ``model_size`` — every violation in one message, by leaf path."""
     if model_size < 1:
         raise ValueError(f"model_size must be >= 1, got {model_size}")
-    recipe = _recipe_for(model_name)
+    recipe, stem = _recipe_for(model_name)
     leaves: List[Tuple[str, Any]] = []
     _walk(params, "", leaves)
     matched = set()
@@ -132,7 +145,7 @@ def plan_for_model(model_name: str, params, batch_stats=None, *,
     stats_specs = jax.tree_util.tree_map(lambda _: P(),
                                          batch_stats or {})
     return TPPlan(model_name, model_size, param_specs, stats_specs,
-                  tuple(rows))
+                  tuple(rows), layers=tuple(recipe.items()), stem=stem)
 
 
 def _unflatten_specs(params, spec_flat: Dict[str, P]):
@@ -155,6 +168,39 @@ def local_param_count(plan: TPPlan) -> int:
             size //= plan.model_size
         n += size
     return n
+
+
+def expected_collectives(plan: TPPlan, *, backward: bool) -> Dict[str, int]:
+    """The model-axis collective budget this plan implies — what the
+    static auditor (ddp_tpu/analysis/) checks every traced program
+    against, and what :func:`format_plan_table` prints.
+
+    Per layer (parallel/tp/layers.py, Megatron's f/g pair): a ``row``
+    layer contributes ONE forward ``psum`` over ``model`` (the partial-sum
+    reduction in row_linear/row_conv2d); a ``column`` layer contributes
+    ONE backward ``psum`` over ``model`` (``_column_input``'s transpose,
+    reducing the input cotangent that row-sharding the next layer leaves
+    partial).  A params-only backward — every train step: gradients are
+    taken w.r.t. params, never the batch — dead-code-eliminates the STEM
+    column layer's input psum (the cotangent it reduces is the batch's,
+    which nothing consumes), so the plan must know the stem
+    (``TP_STEM`` in the model module) to predict the train-step count
+    exactly.  Verified empirically on this runtime: requesting the input
+    gradient too restores the elided psum (tests/test_analysis.py).
+
+    Returns ``{"psum_model_fwd", "psum_model_bwd", "psum_model",
+    "elided_stem_psum"}`` where ``psum_model`` is fwd (+ bwd when
+    ``backward=True``) — the exact count a forward-only program
+    (``backward=False``: serve/eval forwards) or a train step
+    (``backward=True``) must show in its jaxpr."""
+    n_row = sum(1 for _, s in plan.layers if s == "row")
+    n_col = sum(1 for _, s in plan.layers if s == "column")
+    stem_is_column = any(p == plan.stem and s == "column"
+                         for p, s in plan.layers)
+    elided = 1 if (backward and stem_is_column) else 0
+    bwd = (n_col - elided) if backward else 0
+    return {"psum_model_fwd": n_row, "psum_model_bwd": bwd,
+            "psum_model": n_row + bwd, "elided_stem_psum": elided}
 
 
 def state_shardings(plan: TPPlan, mesh: Mesh, *, zero: bool = False):
@@ -211,13 +257,19 @@ def state_specs(plan: TPPlan, *, zero: bool = False):
                       opt_state=opt, step=P())
 
 
+_STYLE_COLLECTIVE = {"column": "psum(model)@bwd", "row": "psum(model)@fwd",
+                     "replicated": "-"}
+
+
 def format_plan_table(plan: TPPlan) -> str:
     """The human-readable plan: one row per leaf (path, style, shape,
-    spec, per-shard shape), then the totals line.  First line is the
-    schema anchor CI greps for."""
+    spec, per-shard shape, the layer's model-axis collective), then the
+    totals line and the expected-collectives line the static auditor
+    checks traced programs against.  First line is the schema anchor CI
+    greps for."""
     header = (f"tensor-parallel plan: {plan.model_name} | "
               f"model axis m={plan.model_size}")
-    cols = ("leaf", "style", "shape", "spec", "per-shard")
+    cols = ("leaf", "style", "shape", "spec", "per-shard", "collectives")
     body = []
     total = sharded = 0
     for path, style, shape, spec in plan.rows:
@@ -228,7 +280,8 @@ def format_plan_table(plan: TPPlan) -> str:
         total += size
         if any(e == MODEL_AXIS for e in spec):
             sharded += size
-        body.append((path, style, str(shape), str(spec), str(local)))
+        body.append((path, style, str(shape), str(spec), str(local),
+                     _STYLE_COLLECTIVE[style]))
     widths = [max(len(c), *(len(r[i]) for r in body))
               for i, c in enumerate(cols)]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
@@ -237,4 +290,10 @@ def format_plan_table(plan: TPPlan) -> str:
     pct = 100.0 * sharded / max(total, 1)
     lines.append(f"total {total:,} params | sharded {sharded:,} "
                  f"({pct:.2f}%) | replicated {total - sharded:,}")
+    exp = expected_collectives(plan, backward=True)
+    elision = (f" (stem {plan.stem}: input-grad psum elided)"
+               if exp["elided_stem_psum"] else "")
+    lines.append(f"expected collectives: psum(model) "
+                 f"fwd={exp['psum_model_fwd']} bwd={exp['psum_model_bwd']} "
+                 f"train={exp['psum_model']}{elision}")
     return "\n".join(lines)

@@ -49,6 +49,11 @@ class Watchdog:
         # is possible — each host's stderr carries its own tail.
         self.context = context
         self.exit_status = int(exit_status)
+        # Monotonic heartbeat float: torn reads are impossible (CPython
+        # float store is atomic) and a stale read only delays expiry by
+        # one poll interval — a lock on the per-step beat() would buy
+        # nothing but contention.
+        # analysis: unlocked-ok(atomic float; staleness bounded by poll)
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -99,19 +99,25 @@ class DynamicBatcher:
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=max(int(queue_depth), 1))
         self.tracer = tracer if tracer is not None else get_tracer()
-        self._holdover: Optional[_Request] = None  # didn't fit last batch
+        # The request that didn't fit the last batch.  Engine-thread-only
+        # between start() and the join in stop(); the post-join flush in
+        # stop() is ordered by Thread.join, not a lock.
+        # analysis: unlocked-ok(engine-thread only; stop reads after join)
+        self._holdover: Optional[_Request] = None
         self._draining = threading.Event()
         self._stopped = threading.Event()  # engine loop has exited
         self._thread: Optional[threading.Thread] = None
         self._stats_lock = threading.Lock()
+        # analysis: shared-under(_stats_lock)
         self._latency_ms: collections.deque = collections.deque(maxlen=4096)
+        # analysis: shared-under(_stats_lock)
         self._batch_rows: collections.deque = collections.deque(maxlen=4096)
-        self.submitted = 0
-        self.served_requests = 0
-        self.shed_queue_full = 0
-        self.rejected_oversize = 0
-        self.timed_out = 0
-        self.batches = 0
+        self.submitted = 0          # analysis: shared-under(_stats_lock)
+        self.served_requests = 0    # analysis: shared-under(_stats_lock)
+        self.shed_queue_full = 0    # analysis: shared-under(_stats_lock)
+        self.rejected_oversize = 0  # analysis: shared-under(_stats_lock)
+        self.timed_out = 0          # analysis: shared-under(_stats_lock)
+        self.batches = 0            # analysis: shared-under(_stats_lock)
 
     # -- caller side -------------------------------------------------------
 

@@ -90,10 +90,15 @@ class ServeEngine:
         self.compute_dtype = compute_dtype
         self.buckets = resolve_buckets(buckets, mesh.devices.size)
         self.max_rows = self.buckets[-1]
-        self.trace_count = 0
+        self.trace_count = 0  # analysis: shared-under(_stats_lock)
 
         def _on_trace() -> None:
-            self.trace_count += 1
+            # Tracing happens inside warm()/forward() calls while /stats
+            # and /healthz threads read the counter — same lock as every
+            # other counter (never held around a device computation, so
+            # no ordering risk with the pipeline _lock).
+            with self._stats_lock:
+                self.trace_count += 1
 
         self._fwd = make_eval_forward(model, mesh, compute_dtype,
                                       on_trace=_on_trace)
@@ -109,10 +114,12 @@ class ServeEngine:
         # must not block behind an in-flight forward (hundreds of ms at
         # load — a health probe that flaps under load is worse than none).
         self._stats_lock = threading.Lock()
-        self._seq = 0  # forward-batch sequence number (span step key)
+        # forward-batch sequence number (span step key)
+        self._seq = 0  # analysis: shared-under(_stats_lock)
+        # analysis: shared-under(_stats_lock)
         self._per_bucket: Dict[int, int] = {b: 0 for b in self.buckets}
-        self.rows_served = 0
-        self.warmed = False
+        self.rows_served = 0  # analysis: shared-under(_stats_lock)
+        self.warmed = False   # analysis: shared-under(_stats_lock)
         # Provenance (set by from_checkpoint): which snapshot this engine
         # answers for — surfaced on /healthz so "what model is live" is
         # one curl, not an ops archaeology session.
@@ -171,8 +178,9 @@ class ServeEngine:
             jax.block_until_ready(self._fwd(
                 self._params, self._stats,
                 jax.device_put(zeros, self._sharding)))
-        self.warmed = True
-        return self.trace_count
+        with self._stats_lock:  # health probes read both concurrently
+            self.warmed = True
+            return self.trace_count
 
     # -- serving -----------------------------------------------------------
 

@@ -155,6 +155,7 @@ def _save_checkpoint_body(path: str, params, batch_stats,
     for section, tree in zip(_SECTIONS,
                              (params, batch_stats, opt_state.momentum_buf)):
         sect_flat: Dict[str, np.ndarray] = {}
+        # analysis: host-sync-ok(checkpoint snapshot - deliberate d2h on the writer thread, off the step loop)
         _flatten(jax.device_get(tree), "", sect_flat)
         flat.update({f"{section}/{k}": v for k, v in sect_flat.items()})
     flat["meta/step"] = np.asarray(int(step), np.int64)

@@ -232,6 +232,7 @@ def _save_sharded_body(path, params, batch_stats, opt_state, step, epoch,
         flat_np: Dict[str, np.ndarray] = {}
         for key, obj, shard_dim in slot_work[slot]:
             data = getattr(obj, "data", obj)  # Shard.data | whole leaf
+            # analysis: host-sync-ok(checkpoint shard write - deliberate one-slot-at-a-time d2h, off the step loop)
             flat_np[key] = np.asarray(jax.device_get(data))
         fpath = os.path.join(d, names[slot])
         shas[slot] = write_npz_hashed(fpath, flat_np)

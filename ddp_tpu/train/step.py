@@ -21,6 +21,12 @@ jitted ``shard_map`` program over a 1-D ``data`` mesh:
 - the per-batch LR is passed in as a traced scalar so the per-step schedule
   (scheduler.step() per batch, singlegpu.py:108) never recompiles.
 
+Every builder here is a registered audit target: ``python -m
+ddp_tpu.analysis`` traces the built step and enforces its collective
+shape declaratively (gradient psums on ``data`` only, donation of the
+state, zero captured constants — analysis/programs.py names the
+programs, analysis/jaxpr_audit.py the invariants).
+
 Running BN buffers are ``pmean``-ed across shards before being returned —
 a deliberate, documented deviation: the reference keeps per-rank buffers and
 checkpoints rank 0's (multigpu.py:110); averaging is statistically at least

@@ -110,6 +110,9 @@ class Trainer:
         self.rng = jax.random.key(seed)
         self.loss_history: List[float] = []
         self._save_thread = None
+        # Written only by the writer thread, read only after its join
+        # (_join_pending_save) — synchronized by Thread.join, not a lock.
+        # analysis: unlocked-ok(join-synchronized error slot)
         self._save_error: Optional[BaseException] = None
         # Deferred loss read (epoch pipelining): (epoch, start_step,
         # stacked device array) of the newest epoch whose losses have not

@@ -14,7 +14,10 @@ Communication volume equals the plain all-reduce (reduce-scatter +
 all-gather IS how XLA lowers an all-reduce), but the momentum buffer and
 the weight update shrink to 1/R per chip — the memory/compute win that
 matters at scale, expressed with explicit ICI collectives over the same
-1-D ``data`` mesh.
+1-D ``data`` mesh.  The pair is a checked invariant: the program auditor
+(``python -m ddp_tpu.analysis``) requires exactly one
+``reduce_scatter`` + one ``all_gather`` over ``data`` in every ZeRO
+update's jaxpr — and zero of either in any non-ZeRO program.
 
 Numerically identical to the replicated path modulo collective reduction
 order (pinned by tests/test_zero.py).  BatchNorm stays per-shard by default;

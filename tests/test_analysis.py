@@ -1,0 +1,267 @@
+"""Program auditor (ddp_tpu/analysis): seeded-faulty fixtures must be
+flagged, the head registry must audit clean, and the (2,4) TP train step's
+collective inventory must match the plan table's expected counts exactly.
+
+The head-clean tests double as the regression pins for the at-head fixes
+this round shipped (PrefetchStats.per_step_ms under its lock,
+ServeEngine.trace_count/warm under _stats_lock, the unlocked-ok /
+host-sync-ok annotations): the ``# analysis: shared-under(...)``
+contracts in those files are re-verified on every run, so removing a lock
+(or an annotation) fails here, not on a chip.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from ddp_tpu.analysis import (build_context, build_programs, fixture_names,
+                              program_names, run_fixture)
+from ddp_tpu.analysis.__main__ import run as cli_run
+from ddp_tpu.analysis.fixtures import ERROR_FIXTURES
+from ddp_tpu.analysis.hostsync import scan_source as hostsync_scan
+from ddp_tpu.analysis.jaxpr_audit import (audit_collectives, audit_constants,
+                                          audit_donation,
+                                          collective_inventory, trace_jaxpr)
+from ddp_tpu.analysis.lockset import lint_source as lockset_lint
+from ddp_tpu.parallel.tp.plan import expected_collectives
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ddp_tpu")
+
+
+# ---------------------------------------------------------------------------
+# Seeded-faulty fixtures: each detector flags its fixture.
+# ---------------------------------------------------------------------------
+
+_EXPECTED_CHECK = {
+    "wrong_axis_psum": "collective-axis",
+    "model_axis_all_gather": "model-gather",
+    "captured_constant": "constant-capture",
+    "missing_donation": "donation",
+    "hot_loop_device_get": "host-sync",
+    "lock_free_shared_attr": "lockset",
+}
+
+
+@pytest.mark.parametrize("name", sorted(_EXPECTED_CHECK))
+def test_fixture_is_flagged(name):
+    findings = run_fixture(name)
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors, f"{name}: no error finding"
+    assert any(f.check == _EXPECTED_CHECK[name] for f in errors), (
+        name, findings)
+
+
+def test_scalar_closure_fixture_warns():
+    findings = run_fixture("scalar_closure")
+    assert [f.check for f in findings] == ["scalar-closure"]
+    assert findings[0].severity == "warning"
+
+
+@pytest.mark.parametrize("name", sorted(ERROR_FIXTURES))
+def test_cli_strict_fails_each_error_fixture(name, capsys):
+    assert cli_run(["--strict", "--fixture", name]) != 0
+    assert "error" in capsys.readouterr().out
+
+
+def test_error_fixtures_cover_the_required_six():
+    assert set(_EXPECTED_CHECK) <= set(ERROR_FIXTURES)
+    assert set(ERROR_FIXTURES) <= set(fixture_names())
+
+
+# ---------------------------------------------------------------------------
+# Head registry: every registered program audits clean, and the TP train
+# step's inventory equals the plan's expected counts exactly.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def head_audit():
+    ctx = build_context()                    # deepnn on the (2,4)x8 mesh
+    programs = build_programs(ctx)
+    out = {}
+    for prog in programs:
+        closed = trace_jaxpr(prog.fn, prog.args)
+        inv = collective_inventory(closed)
+        findings = (audit_collectives(prog.name, prog.kind, inv,
+                                      plan=prog.plan, zero=prog.zero)
+                    + audit_constants(prog.name, closed)
+                    + audit_donation(prog.name, prog.kind, prog.fn,
+                                     prog.args))
+        out[prog.name] = (prog, inv, findings)
+    return ctx, out
+
+
+def test_head_registry_complete(head_audit):
+    _, audited = head_audit
+    # The model supports TP, so every registry entry must have built.
+    assert sorted(audited) == sorted(program_names())
+
+
+def test_head_registry_audits_clean(head_audit):
+    _, audited = head_audit
+    bad = {name: [f for f in findings]
+           for name, (_, _, findings) in audited.items() if findings}
+    assert not bad, bad
+
+
+def test_tp_train_inventory_matches_plan_exactly(head_audit):
+    ctx, audited = head_audit
+    _, inv, _ = audited["train_step@tp"]
+    exp = expected_collectives(ctx.plan, backward=True)
+    # deepnn: 3 row layers psum in the forward; 3 column layers minus the
+    # elided stem psum in the backward.
+    assert exp == {"psum_model_fwd": 3, "psum_model_bwd": 2,
+                   "psum_model": 5, "elided_stem_psum": 1}
+    assert inv[("psum", ("model",))] == exp["psum_model"]
+    assert inv[("psum", ("data",))] > 0          # the gradient reduction
+    assert ("all_gather", ("model",)) not in inv
+
+
+def test_tp_forward_inventory_matches_plan_exactly(head_audit):
+    ctx, audited = head_audit
+    _, inv, _ = audited["serve_forward@tp"]
+    exp = expected_collectives(ctx.plan, backward=False)
+    assert exp["psum_model"] == 3 and exp["psum_model_bwd"] == 0
+    assert inv == {("psum", ("model",)): 3}      # nothing on `data` at all
+
+
+def test_zero_update_shows_the_pair(head_audit):
+    _, audited = head_audit
+    _, inv, _ = audited["train_step_zero@dp8"]
+    assert inv[("reduce_scatter", ("data",))] == 1
+    assert inv[("all_gather", ("data",))] == 1
+
+
+# ---------------------------------------------------------------------------
+# Invariant unit checks (synthetic inventories — no tracing).
+# ---------------------------------------------------------------------------
+
+def test_unknown_axis_is_an_error():
+    findings = audit_collectives(
+        "p", "update", {("psum", ("data",)): 1, ("psum", ("pipe",)): 2})
+    assert any(f.check == "collective-axis" and "pipe" in f.detail
+               for f in findings)
+
+
+def test_forward_with_data_collective_is_an_error():
+    findings = audit_collectives("p", "forward", {("psum", ("data",)): 1})
+    assert any(f.check == "collective-count" for f in findings)
+
+
+def test_zero_without_pair_is_an_error():
+    findings = audit_collectives(
+        "p", "update", {("psum", ("data",)): 1}, zero=True)
+    assert any("reduce_scatter" in f.detail for f in findings)
+
+
+def test_nonzero_update_with_gather_is_an_error():
+    findings = audit_collectives(
+        "p", "update",
+        {("psum", ("data",)): 1, ("all_gather", ("data",)): 1})
+    assert any(f.check == "collective-count" and "non-ZeRO" in f.detail
+               for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# Static passes: head is silent; annotations are honored and enforced.
+# ---------------------------------------------------------------------------
+
+def test_static_passes_silent_at_head():
+    from ddp_tpu.analysis.hostsync import scan_packages
+    from ddp_tpu.analysis.lockset import scan_modules
+    findings = scan_packages(PKG_ROOT) + scan_modules(PKG_ROOT)
+    assert findings == [], findings
+
+
+def test_hostsync_annotation_is_honored():
+    src = ("def f(xs):\n"
+           "    for x in xs:\n"
+           "        # analysis: host-sync-ok(test)\n"
+           "        jax.device_get(x)\n")
+    assert hostsync_scan("t.py", src) == []
+    assert hostsync_scan("t.py", src.replace(
+        "        # analysis: host-sync-ok(test)\n", ""))
+
+
+def test_lockset_shared_under_contract_enforced():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0  # analysis: shared-under(_lock)\n"
+           "    def good(self):\n"
+           "        with self._lock:\n"
+           "            self.n += 1\n"
+           "    def bad(self):\n"
+           "        return self.n\n")
+    findings = lockset_lint("t.py", src)
+    assert len(findings) == 1 and findings[0].check == "lockset"
+    assert "bad()" in findings[0].detail
+    fixed = src.replace("        return self.n",
+                        "        with self._lock:\n"
+                        "            return self.n")
+    assert lockset_lint("t.py", fixed) == []
+
+
+def test_lockset_unknown_lock_name_is_an_error():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.n = 0  # analysis: shared-under(_mutex)\n")
+    findings = lockset_lint("t.py", src)
+    assert len(findings) == 1 and "unknown lock" in findings[0].detail
+
+
+def test_lockset_unlocked_ok_suppresses_discovery():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        # analysis: unlocked-ok(join-synchronized)\n"
+           "        self.err = None\n"
+           "        t = threading.Thread(target=self._run)\n"
+           "    def _run(self):\n"
+           "        self.err = 1\n"
+           "    def check(self):\n"
+           "        return self.err\n")
+    assert lockset_lint("t.py", src) == []
+
+
+def test_lockset_nonlocal_in_thread_closure_is_an_error():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def go(self):\n"
+           "        done = False\n"
+           "        def work():\n"
+           "            nonlocal done\n"
+           "            done = True\n"
+           "        threading.Thread(target=work).start()\n"
+           "        return done\n")
+    findings = lockset_lint("t.py", src)
+    assert any("nonlocal" in f.detail for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface.
+# ---------------------------------------------------------------------------
+
+def test_cli_list(capsys):
+    assert cli_run(["--list"]) == 0
+    out = capsys.readouterr().out
+    assert "train_step@tp" in out and "wrong_axis_psum" in out
+
+
+def test_cli_static_only_strict_clean(capsys, tmp_path):
+    art = tmp_path / "a.json"
+    assert cli_run(["--strict", "--skip-programs",
+                    "--json", str(art)]) == 0
+    data = json.loads(art.read_text())
+    assert data["counts"]["error"] == 0
+    assert data["mesh_shape"] == [2, 4]
+
+
+def test_cli_unknown_program_rejected():
+    with pytest.raises(ValueError, match="unknown program"):
+        cli_run(["--programs", "nope@nowhere", "--skip-static"])

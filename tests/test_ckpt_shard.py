@@ -27,7 +27,7 @@ from ddp_tpu.resilience.lineage import CheckpointLineage
 from ddp_tpu.train.checkpoint import (CheckpointError, LazyLeaf,
                                       Sha256Writer, load_checkpoint,
                                       save_checkpoint, sha256_of_file)
-from ddp_tpu.train.ckpt_shard import (HostBytesProbe, load_for_mesh,
+from ddp_tpu.train.ckpt_shard import (load_for_mesh,
                                       read_shard_index,
                                       save_checkpoint_sharded,
                                       shard_file_name)

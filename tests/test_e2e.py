@@ -2,7 +2,6 @@
 synthetic dataset — loss decreases, checkpoint lands, accuracy is sane
 (the integration tier SURVEY.md §4 prescribes)."""
 import functools
-import sys
 
 import jax
 import numpy as np

@@ -16,7 +16,6 @@ tens of minutes (CPU-backend artifact; the real-TPU compile is ~15 s).
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

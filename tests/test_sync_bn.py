@@ -11,7 +11,6 @@ per-shard statistics — that contrast is asserted too.)
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 

@@ -189,11 +189,23 @@ def test_plan_table_schema(deepnn_params):
     table = format_plan_table(plan).splitlines()
     assert table[0] == "tensor-parallel plan: deepnn | model axis m=4"
     assert table[1].split() == ["leaf", "style", "shape", "spec",
-                                "per-shard"]
-    body = table[2:-1]
+                                "per-shard", "collectives"]
+    body = table[2:-2]
     assert len(body) == 12  # 6 layers x (kernel|weight, bias)
     assert {row.split()[1] for row in body} == {"column", "row"}
-    assert table[-1].startswith("total 1,186,986 params | sharded ")
+    # Expected-collectives column: row leaves psum in the forward, column
+    # leaves in the backward.
+    for row in body:
+        fields = row.split()
+        assert fields[-1] == ("psum(model)@fwd" if fields[1] == "row"
+                              else "psum(model)@bwd")
+    assert table[-2].startswith("total 1,186,986 params | sharded ")
+    # The footer is the same accounting the jaxpr auditor enforces
+    # (analysis/jaxpr_audit.py): 3 row layers psum in the forward, the
+    # stem's backward psum is elided (grads are w.r.t. params only).
+    assert table[-1] == ("expected collectives: psum(model) fwd=3 bwd=2 "
+                         "train=5 (stem features/conv0: input-grad psum "
+                         "elided)")
 
 
 def test_plan_validation_errors(deepnn_params):
