@@ -141,6 +141,22 @@ def _parse_args():
                    help="(--ckpt_bench child) payload size in MiB")
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size (default: all visible devices)")
+    p.add_argument("--calibrate_cost", action="store_true",
+                   help="Calibrate the static cost model (ddp_tpu/"
+                        "analysis/costmodel.py): fit per-op-class time "
+                        "coefficients (s/FLOP for conv and dot, s/byte "
+                        "for elementwise traffic and collective payload) "
+                        "from short measured probes — the ops/"
+                        "conv_probe.py methodology: best-of jitted "
+                        "dependency-linked chains, marginal "
+                        "differencing — then price every analysis-"
+                        "registry program's static cost table through "
+                        "them and print predicted ms/step next to a "
+                        "measured ms/step for the data-parallel train "
+                        "step.  Audits the analysis registry's model "
+                        "(deepnn unless --model overrides); on a CPU "
+                        "host set XLA_FLAGS=--xla_force_host_platform_"
+                        "device_count=8 for the full (2,4)x8 registry")
     p.add_argument("--batch_sweep", default=None, metavar="B1,B2,...",
                    help="MFU-vs-per-chip-batch sweep (VERDICT r5 next #1): "
                         "one subprocess per (batch, flavor) cell on the "
@@ -270,7 +286,8 @@ def main() -> None:
     if args.dump_hlo and (args.sweep or args.pipeline or args.e2e
                           or args.batch_sweep or args.stream_attr
                           or args.serve or args.tp_sweep
-                          or args.ckpt_bench or args.ckpt_bench_child):
+                          or args.ckpt_bench or args.ckpt_bench_child
+                          or args.calibrate_cost):
         raise SystemExit("--dump_hlo only applies to the steady-state step "
                          "bench (it dumps the timed step/scan program); it "
                          "has no program to dump in --sweep/--batch_sweep/"
@@ -281,6 +298,9 @@ def main() -> None:
         return
     if args.ckpt_bench:
         _bench_ckpt(args)
+        return
+    if args.calibrate_cost:
+        _bench_calibrate_cost(args)
         return
     if args.serve:
         _bench_serve(args)
@@ -1309,6 +1329,182 @@ def _bench_e2e(args) -> None:
         "unit": "samples/sec/chip",
         "vs_baseline": 1.0,
         "phase_ms": phase_ms,
+    }))
+
+
+def _bench_calibrate_cost(args) -> None:
+    """Fit per-op-class time coefficients from short measured probes and
+    price the analysis registry's static cost table through them.
+
+    Probes follow ops/conv_probe.py exactly: each op class is timed as a
+    jitted UNROLLED chain of dependency-linked calls (the ``+ acc*1e-30``
+    link forces serial execution without changing the math) at two chain
+    lengths, and the reported per-call time is the MARGINAL
+    ``(t_long - t_short) / (N_LONG - N_SHORT)`` — dispatch/sync overhead
+    cancels.  Four coefficients: s/FLOP for conv and for dot (the
+    compute-bound classes), s/byte for elementwise memory traffic (the
+    cost model's bytes-touched convention: operands + result), and
+    s/payload-byte for collectives.
+
+    The prediction is the ADDITIVE no-overlap model
+    ``conv_flops*c_conv + dot_flops*c_dot + bytes*c_byte +
+    collective_payload*c_coll`` — an upper bound a fused/overlapped
+    program beats, meant for ranking programs and catching
+    order-of-magnitude cost-table regressions, not as a roofline.
+    Measured ms/step (same marginal methodology over the real jitted
+    step at a shorter window — each call is a full train step) is
+    reported next to the prediction for the data-parallel train step.
+    The prediction prices ONE shard's body (the cost model's unit); on
+    a virtual CPU mesh the shards SERIALIZE on the host, so measured
+    ~= n_dev x predicted there — on a real pod, where shards run in
+    parallel, the two are directly comparable.  One JSON line on
+    stdout."""
+    from jax.sharding import PartitionSpec as P
+
+    from ddp_tpu.analysis.costmodel import program_cost
+    from ddp_tpu.analysis.jaxpr_audit import trace_jaxpr
+    from ddp_tpu.analysis.programs import (DEFAULT_MODEL, build_context,
+                                           build_programs)
+    from ddp_tpu.ops.conv_probe import (N_LONG, N_SHORT, best_of,
+                                        conv_flops)
+    from ddp_tpu.ops.layers import conv2d
+
+    repeats = max(1, min(args.repeats, 4))
+
+    def fit(make_chain, chain_args, work_per_call):
+        t_s = best_of(make_chain(N_SHORT), chain_args, repeats)
+        t_l = best_of(make_chain(N_LONG), chain_args, repeats)
+        marginal = max((t_l - t_s) / (N_LONG - N_SHORT), 1e-12)
+        return marginal / work_per_call
+
+    # conv: deepnn-interior-ish SAME 3x3 shape (16x16x64 -> 64).
+    xc = jnp.ones((8, 16, 16, 64), jnp.float32)
+    wc = jnp.ones((3, 3, 64, 64), jnp.float32)
+
+    def conv_chain(n):
+        def win(x, w):
+            acc = jnp.zeros((), x.dtype)
+            for _ in range(n):
+                acc = jnp.mean(conv2d(x, w + acc * 1e-30))
+            return acc
+        return jax.jit(win)
+
+    c_conv = fit(conv_chain, (xc, wc), conv_flops(8, 16, 64, 64))
+
+    # dot: square matmul, 2*K^3 FLOPs/call.
+    k = 256
+    xd = jnp.ones((k, k), jnp.float32)
+    wd = jnp.ones((k, k), jnp.float32)
+
+    def dot_chain(n):
+        def win(x, w):
+            acc = jnp.zeros((), x.dtype)
+            for _ in range(n):
+                acc = jnp.mean(x @ (w + acc * 1e-30))
+            return acc
+        return jax.jit(win)
+
+    c_dot = fit(dot_chain, (xd, wd), 2.0 * k * k * k)
+
+    # elementwise bytes: one mul (read 4 MiB + write 4 MiB) + one mean
+    # (read 4 MiB) per link = 3 * size * itemsize bytes-touched/call,
+    # matching the cost model's operands-plus-result convention.
+    ve = jnp.ones((1 << 20,), jnp.float32)
+
+    def ew_chain(n):
+        def win(v):
+            acc = jnp.zeros((), v.dtype)
+            for _ in range(n):
+                acc = jnp.mean(v * (1.0 + acc * 1e-30))
+            return acc
+        return jax.jit(win)
+
+    c_byte = fit(ew_chain, (ve,), 3.0 * ve.size * 4)
+
+    # collective: psum over the mesh's first axis inside shard_map; the
+    # cost model charges a collective its PER-SHARD operand bytes, so
+    # that is the work unit here too.  The link's add/mean traffic rides
+    # along (the coefficient slightly upper-bounds pure transport).
+    mesh = make_mesh(args.num_devices)
+    axis = mesh.axis_names[0]
+    vc = jnp.ones((mesh.devices.size * (1 << 16),), jnp.float32)
+    shard_bytes = vc.size * 4 // mesh.devices.size
+
+    def coll_chain(n):
+        def body(v):
+            acc = jnp.zeros((), v.dtype)
+            for _ in range(n):
+                acc = jnp.mean(jax.lax.psum(v + acc * 1e-30, axis))
+            return acc
+        return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(axis),
+                                     out_specs=P()))
+
+    c_coll = fit(coll_chain, (vc,), shard_bytes)
+
+    # Price the registry.  The bench-level default model is vgg, but the
+    # analysis registry (and BUDGETS.json) defaults to deepnn — follow
+    # the registry unless the user explicitly picked something else.
+    model_name = DEFAULT_MODEL if args.model == "vgg" else args.model
+    n_dev = jax.device_count()
+    m = 4 if n_dev % 4 == 0 else (2 if n_dev % 2 == 0 else 1)
+    ctx = build_context(model_name, mesh_2d=(n_dev // m, m))
+    progs = build_programs(ctx)
+    predicted = {}
+    for prog in progs:
+        cost = program_cost(trace_jaxpr(prog.fn, prog.args))
+        pred_s = (cost.by_class["conv"] * c_conv
+                  + cost.by_class["dot"] * c_dot
+                  + cost.bytes * c_byte
+                  + cost.collective_payload_bytes * c_coll)
+        predicted[prog.name] = round(pred_s * 1e3, 3)
+
+    # Measured ms/step for the flagship data-parallel train step: the
+    # same marginal differencing, at a shorter window (each call is a
+    # full train step, not a microsecond kernel).  Each timed window
+    # starts from freshly materialised zero buffers so donation on a
+    # real accelerator cannot invalidate reused args.
+    meas_name = "train_step@dp8"
+    prog = next(p for p in progs if p.name == meas_name)
+    w_short, w_long = 2, 8
+
+    def mat(x):
+        if jax.dtypes.issubdtype(x.dtype, jax.dtypes.prng_key):
+            return jax.random.key(0)
+        return jnp.zeros(x.shape, x.dtype)
+
+    def window(n):
+        state, batch, rng = jax.tree_util.tree_map(mat, prog.args)
+        out = None
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = prog.fn(state, batch, rng)
+            state = out[0]
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    window(1)  # compile + warm
+    t_s = min(window(w_short) for _ in range(repeats))
+    t_l = min(window(w_long) for _ in range(repeats))
+    measured_ms = max(t_l - t_s, 0.0) / (w_long - w_short) * 1e3
+
+    print(json.dumps({
+        "metric": f"{model_name} cost-model calibration: predicted vs "
+                  f"measured ms/step ({n_dev}-device "
+                  f"{jax.default_backend()} mesh)",
+        "value": predicted.get(meas_name),
+        "unit": "ms/step",
+        "vs_baseline": 1.0,
+        "measured_ms_per_step": {meas_name: round(measured_ms, 3)},
+        "predicted_ms_per_step": predicted,
+        "note": "prediction prices one shard's body; a virtual CPU "
+                "mesh serializes shards, so expect measured ~= "
+                f"{n_dev} x predicted there",
+        "coefficients": {
+            "conv_s_per_flop": c_conv,
+            "dot_s_per_flop": c_dot,
+            "elementwise_s_per_byte": c_byte,
+            "collective_s_per_payload_byte": c_coll,
+        },
     }))
 
 

@@ -11,15 +11,27 @@ Three detector families behind one findings model and one CLI
   all_gathers, collective-free serve forwards, the ZeRO
   reduce_scatter/all_gather pair), plus constant-capture and donation
   checks on the same trace.
+- **cost model** (``costmodel``) — per-program FLOPs (dot/conv via
+  dimension-numbers arithmetic), bytes touched and collective payload
+  volume per mesh axis on the same trace, diffed against the
+  ``BUDGETS.json`` per-program ceilings (the cost-regression CI gate).
+- **liveness** (``liveness``) — donation-aware linear-scan buffer
+  liveness over the per-shard program body: the static peak-live-bytes
+  estimate that turns TP's ÷m and ZeRO's optimizer-state memory wins
+  into asserted numbers.
 - **host-sync pass** (``hostsync``) — AST scan of ``train/``, ``data/``,
   ``serve/`` for device->host transfers inside step/epoch loops.
 - **lockset lint** (``lockset``) — AST-derived shared-attribute access
-  sets vs declared lock scopes in the threaded subsystems, with the
-  ``# analysis: shared-under(...)`` / ``unlocked-ok(...)`` /
-  ``host-sync-ok(...)`` annotation vocabulary as the audit trail.
+  sets vs declared lock scopes in the threaded subsystems.
+- **divergence lint** (``divergence``) — AST/CFG scan for collectives
+  reachable under host-local conditions (rank checks, exception
+  handlers, conditional early returns) — the whole-pod-hang shape.
 
-``fixtures`` holds one seeded-faulty program per detector — the
-auditor's own regression suite.
+The annotation vocabulary (``# analysis: shared-under(...)`` /
+``unlocked-ok(...)`` / ``host-sync-ok(...)`` / ``divergence-ok(...)``)
+is the greppable audit trail for deliberate exceptions.  ``fixtures``
+holds one seeded-faulty program per detector — the auditor's own
+regression suite.
 """
 from .findings import (Finding, SEVERITIES, count_by_severity,  # noqa: F401
                        format_table, make_finding)
@@ -27,8 +39,14 @@ from .jaxpr_audit import (COLLECTIVE_PRIMITIVES,  # noqa: F401
                           audit_collectives, audit_constants,
                           audit_donation, collective_inventory,
                           inventory_as_json, trace_jaxpr)
+from .costmodel import (BUDGET_METRICS, Cost, check_budgets,  # noqa: F401
+                        cost_summary, layer_forward_costs, make_budgets,
+                        program_cost)
+from .liveness import liveness_of  # noqa: F401
 from .hostsync import scan_packages  # noqa: F401
 from .lockset import scan_modules  # noqa: F401
+from .divergence import scan_source as divergence_scan_source  # noqa: F401
+from .divergence import scan_packages as divergence_scan  # noqa: F401
 from .programs import (REGISTRY, BuiltProgram, ProgramSpec,  # noqa: F401
                        build_context, build_programs, program_names)
 from .fixtures import FIXTURES, fixture_names, run_fixture  # noqa: F401

@@ -5,15 +5,23 @@ a virtual mesh:
 
 1. the jaxpr collective auditor (axis/count invariants per program),
 2. the constant-capture and donation checks,
-3. the host-sync AST pass over ``train/``, ``data/``, ``serve/``,
-4. the lockset lint over the threaded subsystems,
+3. the static cost model (per-program FLOPs / bytes / collective
+   payload, ``costmodel``) and the donation-aware peak-liveness
+   estimate (``liveness``), diffed against ``BUDGETS.json`` when one
+   applies (``--budgets``/``--write-budgets``) — the cost-regression
+   gate,
+4. the host-sync AST pass over ``train/``, ``data/``, ``serve/``,
+5. the lockset lint over the threaded subsystems,
+6. the multi-host divergence lint (``divergence``) over the host-side
+   coordination code,
 
 prints one findings table, optionally writes the JSON artifact CI
-uploads, and with ``--strict`` exits nonzero on any ``error`` finding —
-the CI gate.  ``--fixture <name>`` runs one seeded-faulty fixture
-instead (every error-level fixture must fail ``--strict``; that is
-tested).  Tracing is abstract: no XLA compile, no device memory — the
-full default registry audits in seconds on one CPU process.
+uploads (now including the per-program cost table), and with
+``--strict`` exits nonzero on any ``error`` finding — the CI gate.
+``--fixture <name>`` runs one seeded-faulty fixture instead (every
+error-level fixture must fail ``--strict``; that is tested).  Tracing is
+abstract: no XLA compile, no device memory — the full default registry
+audits in seconds on one CPU process.
 """
 from __future__ import annotations
 
@@ -45,13 +53,23 @@ def _parse(argv: Optional[List[str]]) -> argparse.Namespace:
                    default=None, metavar="D,M",
                    help="(data, model) mesh shape, default 2,4; the 1-D "
                         "programs use all D*M devices")
+    from .fixtures import fixture_names
     p.add_argument("--fixture", metavar="NAME",
                    help="run one seeded-faulty fixture instead of the "
-                        "registry (see --list)")
+                        "registry: " + ", ".join(fixture_names()))
+    p.add_argument("--budgets", metavar="PATH", default=None,
+                   help="per-program cost budget file to diff against "
+                        "(default: BUDGETS.json at the repo root, when "
+                        "present)")
+    p.add_argument("--write-budgets", action="store_true",
+                   help="re-baseline: write the current cost table to "
+                        "the budget file instead of diffing against it")
     p.add_argument("--skip-programs", action="store_true",
-                   help="skip the jaxpr auditors (static passes only)")
+                   help="skip the jaxpr auditors and the cost/liveness "
+                        "passes (static passes only)")
     p.add_argument("--skip-static", action="store_true",
-                   help="skip the host-sync and lockset passes")
+                   help="skip the host-sync, lockset and divergence "
+                        "passes")
     p.add_argument("--list", action="store_true",
                    help="list registered programs and fixtures, exit")
     return p.parse_args(argv)
@@ -77,6 +95,38 @@ def _mesh_shape(arg: Optional[str]):
     if len(parts) != 2 or min(parts) < 1:
         raise SystemExit(f"--mesh-shape wants D,M (got {arg!r})")
     return tuple(parts)
+
+
+def _default_budgets_path() -> str:
+    """BUDGETS.json at the repo root (the package's parent directory)."""
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "BUDGETS.json")
+
+
+def _budget_pass(args, cost_table, model_name, mesh_shape, *,
+                 partial: bool, out):
+    """Write or diff the per-program budget file.  Diffing is skipped
+    (silently) when no budget file exists — a fresh checkout without a
+    baseline must not fail ``--strict``."""
+    from .costmodel import check_budgets, make_budgets
+    path = args.budgets or _default_budgets_path()
+    if args.write_budgets:
+        table = {name: {m: row[m] for m in
+                        ("flops", "bytes", "peak_live_bytes",
+                         "collective_payload_bytes")}
+                 for name, row in cost_table.items()}
+        doc = make_budgets(table, model_name, mesh_shape)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print(f"wrote budgets to {path}", file=out)
+        return []
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        budgets = json.load(fh)
+    return check_budgets(cost_table, budgets, model_name, mesh_shape,
+                         partial=partial)
 
 
 def _inventory_summary(inv) -> str:
@@ -109,21 +159,25 @@ def run(argv: Optional[List[str]] = None,
 
     findings = []
     inventories = {}
+    cost_table = {}
+    model_name = None
 
     if args.fixture:
         from .fixtures import run_fixture
         findings.extend(run_fixture(args.fixture))
     else:
         if not args.skip_programs:
+            from .costmodel import cost_summary, program_cost
             from .jaxpr_audit import (audit_collectives, audit_constants,
                                       audit_donation, collective_inventory,
                                       inventory_as_json, trace_jaxpr)
+            from .liveness import liveness_of
             from .programs import (DEFAULT_MODEL, build_context,
                                    build_programs)
             names = ([n.strip() for n in args.programs.split(",")
                       if n.strip()] if args.programs else None)
-            ctx = build_context(args.model or DEFAULT_MODEL,
-                                mesh_2d=mesh_shape)
+            model_name = args.model or DEFAULT_MODEL
+            ctx = build_context(model_name, mesh_2d=mesh_shape)
             for prog in build_programs(ctx, names):
                 closed = trace_jaxpr(prog.fn, prog.args)
                 inv = collective_inventory(closed)
@@ -131,19 +185,31 @@ def run(argv: Optional[List[str]] = None,
                 findings.append(make_finding(
                     "info", "inventory", prog.name,
                     _inventory_summary(inv)))
+                cost = program_cost(closed)
+                live = liveness_of(closed)
+                cost_table[prog.name] = {**cost.as_json(), **live}
+                findings.append(make_finding(
+                    "info", "cost", prog.name,
+                    cost_summary(cost, live["peak_live_bytes"])))
                 findings.extend(audit_collectives(
                     prog.name, prog.kind, inv, plan=prog.plan,
                     zero=prog.zero))
                 findings.extend(audit_constants(prog.name, closed))
                 findings.extend(audit_donation(
                     prog.name, prog.kind, prog.fn, prog.args))
+            findings.extend(_budget_pass(args, cost_table, model_name,
+                                         mesh_shape,
+                                         partial=names is not None,
+                                         out=out))
         if not args.skip_static:
+            from .divergence import scan_packages as divergence_scan
             from .hostsync import scan_packages
             from .lockset import scan_modules
             pkg_root = os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))
             findings.extend(scan_packages(pkg_root))
             findings.extend(scan_modules(pkg_root))
+            findings.extend(divergence_scan(pkg_root))
 
     print(format_table(findings), file=out)
     counts = count_by_severity(findings)
@@ -152,6 +218,7 @@ def run(argv: Optional[List[str]] = None,
         artifact = {"counts": counts,
                     "findings": [f.as_json() for f in findings],
                     "inventories": inventories,
+                    "cost_table": cost_table,
                     "mesh_shape": list(mesh_shape)}
         with open(args.json, "w", encoding="utf-8") as fh:
             json.dump(artifact, fh, indent=2, sort_keys=True)

@@ -121,6 +121,27 @@ def missing_donation() -> List[Finding]:
     return audit_donation("fixture:missing_donation", "update", fn, (w, g))
 
 
+def budget_buster() -> List[Finding]:
+    """A program ~30,000x over its flop budget — the cost-regression
+    gate (``costmodel.check_budgets``) must flag it."""
+    import jax
+    import jax.numpy as jnp
+
+    from .costmodel import check_budgets, program_cost
+
+    def _body(a, b):
+        return a @ b
+
+    a = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    b = jax.ShapeDtypeStruct((256, 256), jnp.float32)
+    cost = program_cost(_trace(_body, a, b))     # 2*256^3 = 33.6 MFLOP
+    table = {"fixture_matmul": cost.budget_row()}
+    budgets = {"model": "fixture", "mesh_shape": [1, 1],
+               "tolerance_pct": 10.0,
+               "programs": {"fixture_matmul": {"flops": 1000}}}
+    return check_budgets(table, budgets, "fixture", (1, 1))
+
+
 def scalar_closure() -> List[Finding]:
     """A strongly-typed np hyperparameter closed into the program — it
     retraces per distinct value (warning-level: slow, not wrong).  Shape
@@ -193,6 +214,26 @@ def lock_free_shared_attr() -> List[Finding]:
                        _LOCK_FREE_SHARED_ATTR)
 
 
+_RANK_GATED_COLLECTIVE = textwrap.dedent("""\
+    import jax
+    from jax import lax
+
+    def flush_epoch(stats):
+        if jax.process_index() == 0:      # host-local rank check
+            return lax.psum(stats, "data")
+        return stats
+    """)
+
+
+def rank_gated_collective() -> List[Finding]:
+    """A ``psum`` only rank 0 reaches — the other hosts never enter the
+    collective and the pod hangs; the divergence lint's canonical
+    finding."""
+    from .divergence import scan_source
+    return scan_source("fixture:rank_gated_collective.py",
+                       _RANK_GATED_COLLECTIVE)
+
+
 # ---------------------------------------------------------------------------
 
 FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
@@ -202,6 +243,8 @@ FIXTURES: Dict[str, Callable[[], List[Finding]]] = {
     "missing_donation": missing_donation,
     "hot_loop_device_get": hot_loop_device_get,
     "lock_free_shared_attr": lock_free_shared_attr,
+    "budget_buster": budget_buster,
+    "rank_gated_collective": rank_gated_collective,
     "scalar_closure": scalar_closure,
 }
 

@@ -240,9 +240,12 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "ddp_tpu.analysis --strict) over the registered "
                         "program families for this --model and mesh shape "
                         "before training — collective axes/counts vs the "
-                        "TP plan, donation, constant capture, plus the "
-                        "host-sync and lockset lints — and abort on any "
-                        "error finding (RUNBOOK.md section 12)")
+                        "TP plan, donation, constant capture, the static "
+                        "cost/peak-liveness estimates diffed against "
+                        "BUDGETS.json (the cost-regression gate), plus "
+                        "the host-sync, lockset and multi-host-"
+                        "divergence lints — and abort on any error "
+                        "finding (RUNBOOK.md sections 12-13)")
     return p
 
 
@@ -306,8 +309,9 @@ def _preflight_audit(args: argparse.Namespace) -> None:
     """``--audit``: trace-audit the program families this run will build
     BEFORE any device state exists (ddp_tpu/analysis).  Tracing is
     abstract, so the cost is seconds; an error finding (wrong-axis
-    collective, missing donation, captured constant, lockset/host-sync
-    violation) aborts the run here instead of wasting a chip
+    collective, missing donation, captured constant, cost-budget
+    overrun, lockset/host-sync violation, unguarded divergent
+    collective) aborts the run here instead of wasting a chip
     reservation."""
     from .analysis.__main__ import run as audit_run
     if args.mesh_shape:

@@ -101,6 +101,9 @@ def straggler_report(medians: Dict[str, float], mesh=None
     Phases nobody timed this epoch are omitted.
     """
     import jax
+    # Call contract (docstring + _log_stragglers): every rank passes the
+    # same mesh, or every rank passes None — the branch is uniform.
+    # analysis: divergence-ok(mesh passed uniformly by call contract)
     if mesh is not None and jax.process_count() > 1:
         rows = _gather_host_rows(mesh, _median_vector(medians))
     else:
@@ -131,6 +134,7 @@ def epoch_straggler_record(tracer, mesh, since: float,
     gather, and (rank 0, when ``metrics`` is given) log the
     ``phase_stragglers`` event.  Returns the report (all ranks)."""
     if not getattr(tracer, "enabled", False):
+        # analysis: divergence-ok(enabled is shared CLI config)
         return None
     report = straggler_report(
         phase_medians(tracer.spans_since(since), include_overlap=False),

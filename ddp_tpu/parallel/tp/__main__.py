@@ -2,8 +2,11 @@
 
 The offline view of what the CLI prints at startup under ``--mesh_shape``:
 resolve the model's TP_RECIPE against a fresh param pytree at the given
-model-axis size, validate it, and print the plan table (exit non-zero on
-an infeasible plan).  CI schema-checks this output.
+model-axis size, validate it, print the plan table with the per-layer
+predicted-cost column (``analysis.costmodel.layer_forward_costs``; the
+column is omitted when the recipe doesn't map 1:1 onto the traced
+conv/dot ops), and exit non-zero on an infeasible plan.  CI
+schema-checks this output, footers included.
 """
 from __future__ import annotations
 
@@ -23,11 +26,14 @@ def main() -> None:
     p.add_argument("--model_axis", default=4, type=int, metavar="M",
                    help="model-axis size to plan for (default 4)")
     args = p.parse_args()
+    from ...analysis.costmodel import layer_forward_costs
     from ...models import get_model
-    params, batch_stats = get_model(args.model).init(jax.random.key(0))
+    model = get_model(args.model)
+    params, batch_stats = model.init(jax.random.key(0))
     plan = plan_for_model(args.model, params, batch_stats,
                           model_size=args.model_axis)
-    print(format_plan_table(plan))
+    costs = layer_forward_costs(model, plan, params, batch_stats)
+    print(format_plan_table(plan, layer_costs=costs))
 
 
 if __name__ == "__main__":

@@ -261,17 +261,36 @@ _STYLE_COLLECTIVE = {"column": "psum(model)@bwd", "row": "psum(model)@fwd",
                      "replicated": "-"}
 
 
-def format_plan_table(plan: TPPlan) -> str:
+def _layer_of(plan: TPPlan, path: str) -> Optional[str]:
+    for prefix, _style in plan.layers:
+        if path == prefix or path.startswith(prefix + "/"):
+            return prefix
+    return None
+
+
+def format_plan_table(plan: TPPlan,
+                      layer_costs: Optional[Dict[str, int]] = None) -> str:
     """The human-readable plan: one row per leaf (path, style, shape,
     spec, per-shard shape, the layer's model-axis collective), then the
     totals line and the expected-collectives line the static auditor
     checks traced programs against.  First line is the schema anchor CI
-    greps for."""
+    greps for.
+
+    ``layer_costs`` (``{recipe layer path: forward flops per image}``,
+    from ``analysis.costmodel.layer_forward_costs``) adds the predicted
+    per-layer cost column: THIS SHARD's forward MFLOPs per image (a
+    column/row layer computes 1/m of the layer; replicated leaves
+    compute all of it), printed on the layer's first leaf row, plus the
+    ``predicted cost:`` footer totals — schema-checked in CI like the
+    expected-collectives line."""
     header = (f"tensor-parallel plan: {plan.model_name} | "
               f"model axis m={plan.model_size}")
     cols = ("leaf", "style", "shape", "spec", "per-shard", "collectives")
+    if layer_costs is not None:
+        cols += ("fwd-mflop",)
     body = []
     total = sharded = 0
+    costed: set = set()
     for path, style, shape, spec in plan.rows:
         local = tuple(s // plan.model_size if e == MODEL_AXIS else s
                       for s, e in zip(shape,
@@ -280,8 +299,18 @@ def format_plan_table(plan: TPPlan) -> str:
         total += size
         if any(e == MODEL_AXIS for e in spec):
             sharded += size
-        body.append((path, style, str(shape), str(spec), str(local),
-                     _STYLE_COLLECTIVE[style]))
+        row = (path, style, str(shape), str(spec), str(local),
+               _STYLE_COLLECTIVE[style])
+        if layer_costs is not None:
+            layer = _layer_of(plan, path)
+            cell = "-"
+            if (layer is not None and layer not in costed
+                    and layer in layer_costs):
+                costed.add(layer)
+                shard_div = plan.model_size if style in STYLES else 1
+                cell = f"{layer_costs[layer] / shard_div / 1e6:.2f}"
+            row += (cell,)
+        body.append(row)
     widths = [max(len(c), *(len(r[i]) for r in body))
               for i, c in enumerate(cols)]
     fmt = "  ".join(f"{{:<{w}}}" for w in widths)
@@ -290,6 +319,15 @@ def format_plan_table(plan: TPPlan) -> str:
     pct = 100.0 * sharded / max(total, 1)
     lines.append(f"total {total:,} params | sharded {sharded:,} "
                  f"({pct:.2f}%) | replicated {total - sharded:,}")
+    if layer_costs is not None:
+        full = sum(layer_costs.values())
+        per_shard = sum(
+            flops / (plan.model_size if style in STYLES else 1)
+            for (layer, style) in plan.layers
+            for flops in (layer_costs.get(layer),) if flops is not None)
+        lines.append(f"predicted cost: fwd {full / 1e6:.2f} MFLOP/img "
+                     f"unsharded | {per_shard / 1e6:.2f} MFLOP/img per "
+                     f"model shard")
     exp = expected_collectives(plan, backward=True)
     elision = (f" (stem {plan.stem}: input-grad psum elided)"
                if exp["elided_stem_psum"] else "")

@@ -124,6 +124,10 @@ class PreemptionGuard:
         Multi-host this is a COLLECTIVE — every process must call it at
         every epoch boundary, in the same order relative to the trainer's
         other collectives, whether or not it saw a signal locally.
+        The ``if _process_any(mesh, local):`` shape below — a collective
+        in the *test* position, never under a host-local branch — is the
+        pattern the divergence lint (``analysis/divergence.py``)
+        sanctions: decide collectively, then branch.
         """
         from ..parallel import dist
         local = self._noticed.is_set()

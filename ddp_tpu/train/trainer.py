@@ -662,6 +662,7 @@ class Trainer:
             # epoch boundary for monitored runs).
             epoch_callback(epoch)
         self._log_stragglers(epoch, t_epoch)
+        # analysis: divergence-ok(ctor-time config, identical on all ranks)
         if self._preemption is not None:
             # COLLECTIVE on multi-host (resilience/preemption.py): every
             # rank calls it at every epoch boundary so the stop decision —
@@ -682,6 +683,7 @@ class Trainer:
         extra programs behind an in-flight epoch, see
         _save_checkpoint_inner's hazard note)."""
         if not self.tracer.enabled:
+            # analysis: divergence-ok(enabled is shared CLI config)
             return
         multi = dist.process_count() > 1
         if not multi and (self.metrics is None

@@ -19,11 +19,16 @@ import pytest
 from ddp_tpu.analysis import (build_context, build_programs, fixture_names,
                               program_names, run_fixture)
 from ddp_tpu.analysis.__main__ import run as cli_run
+from ddp_tpu.analysis.costmodel import (BUDGET_METRICS, check_budgets,
+                                        layer_forward_costs, make_budgets,
+                                        program_cost)
+from ddp_tpu.analysis.divergence import scan_source as divergence_scan
 from ddp_tpu.analysis.fixtures import ERROR_FIXTURES
 from ddp_tpu.analysis.hostsync import scan_source as hostsync_scan
 from ddp_tpu.analysis.jaxpr_audit import (audit_collectives, audit_constants,
                                           audit_donation,
                                           collective_inventory, trace_jaxpr)
+from ddp_tpu.analysis.liveness import liveness_of
 from ddp_tpu.analysis.lockset import lint_source as lockset_lint
 from ddp_tpu.parallel.tp.plan import expected_collectives
 
@@ -42,6 +47,8 @@ _EXPECTED_CHECK = {
     "missing_donation": "donation",
     "hot_loop_device_get": "host-sync",
     "lock_free_shared_attr": "lockset",
+    "budget_buster": "budget",
+    "rank_gated_collective": "divergence",
 }
 
 
@@ -66,7 +73,7 @@ def test_cli_strict_fails_each_error_fixture(name, capsys):
     assert "error" in capsys.readouterr().out
 
 
-def test_error_fixtures_cover_the_required_six():
+def test_error_fixtures_cover_the_required_eight():
     assert set(_EXPECTED_CHECK) <= set(ERROR_FIXTURES)
     assert set(ERROR_FIXTURES) <= set(fixture_names())
 
@@ -169,9 +176,11 @@ def test_nonzero_update_with_gather_is_an_error():
 # ---------------------------------------------------------------------------
 
 def test_static_passes_silent_at_head():
+    from ddp_tpu.analysis.divergence import scan_packages as div_scan
     from ddp_tpu.analysis.hostsync import scan_packages
     from ddp_tpu.analysis.lockset import scan_modules
-    findings = scan_packages(PKG_ROOT) + scan_modules(PKG_ROOT)
+    findings = (scan_packages(PKG_ROOT) + scan_modules(PKG_ROOT)
+                + div_scan(PKG_ROOT))
     assert findings == [], findings
 
 
@@ -265,3 +274,274 @@ def test_cli_static_only_strict_clean(capsys, tmp_path):
 def test_cli_unknown_program_rejected():
     with pytest.raises(ValueError, match="unknown program"):
         cli_run(["--programs", "nope@nowhere", "--skip-static"])
+
+
+# ---------------------------------------------------------------------------
+# Cost model: the deepnn train step's matmul FLOPs must equal the hand
+# count EXACTLY, the total within 1%; synthetic single-op programs pin
+# the per-class formulas.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def head_costs(head_audit):
+    _, audited = head_audit
+    out = {}
+    for name, (prog, _, _) in audited.items():
+        closed = trace_jaxpr(prog.fn, prog.args)
+        out[name] = (program_cost(closed), liveness_of(closed))
+    return out
+
+
+def test_deepnn_train_step_flops_match_hand_count(head_costs):
+    # Per-shard batch: _BATCH=32 over the 8-device data axis.
+    n = 4
+    # deepnn geometry (models/deepnn.py _FEATURES): four SAME 3x3 convs
+    # at (H, C_in, C_out) with maxpools after conv1 and conv3, then
+    # 2048->512->10 linears.  MAC-pair FLOPs: 2*N*H*H*Cout*9*Cin per
+    # conv, 2*N*In*Out per linear.
+    convs = [(32, 3, 128), (32, 128, 64), (16, 64, 64), (16, 64, 32)]
+    fwd = sum(2 * n * h * h * co * 9 * ci for h, ci, co in convs)
+    fwd += 2 * n * 2048 * 512 + 2 * n * 512 * 10
+    # Train = fwd + dgrad + wgrad = 3x fwd, minus the stem conv's dgrad
+    # (no gradient w.r.t. the network input is ever formed).
+    stem_dgrad = 2 * n * 32 * 32 * 128 * 9 * 3
+    hand = 3 * fwd - stem_dgrad
+    cost, _ = head_costs["train_step@dp8"]
+    matmul = cost.by_class["conv"] + cost.by_class["dot"]
+    assert matmul == hand, (matmul, hand)
+    # Elementwise + reductions (loss, SGD, bias adds) ride on top but
+    # must stay under 1% of the matmul work for this model.
+    assert abs(cost.flops - hand) / hand < 0.01
+
+
+def test_dot_flops_exact_2mnk():
+    import jax
+    import jax.numpy as jnp
+    closed = jax.make_jaxpr(lambda a, b: a @ b)(
+        jnp.ones((8, 32)), jnp.ones((32, 16)))
+    cost = program_cost(closed)
+    assert cost.by_class["dot"] == 2 * 8 * 32 * 16
+    assert cost.by_class["conv"] == 0
+
+
+def test_conv_flops_exact_dimension_numbers():
+    import jax
+    import jax.numpy as jnp
+    from ddp_tpu.ops.layers import conv2d
+    closed = jax.make_jaxpr(lambda x, w: conv2d(x, w))(
+        jnp.ones((2, 8, 8, 3)), jnp.ones((3, 3, 3, 16)))
+    cost = program_cost(closed)
+    # SAME 3x3 stride 1: 2 * prod(out) * (Cin * Kh * Kw)
+    assert cost.by_class["conv"] == 2 * (2 * 8 * 8 * 16) * (3 * 3 * 3)
+
+
+def test_collective_payload_counted():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ddp_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh(shape=(2, 4))
+    f = jax.jit(jax.shard_map(lambda x: jax.lax.psum(x, "data"),
+                              mesh=mesh, in_specs=P("data"),
+                              out_specs=P()))
+    closed = jax.make_jaxpr(f)(jnp.ones((8, 4), jnp.float32))
+    cost = program_cost(closed)
+    assert cost.collective_count == 1
+    # payload = the PER-SHARD operand: (8/2, 4) fp32
+    assert cost.collective_payload_bytes == 4 * 4 * 4
+
+
+# ---------------------------------------------------------------------------
+# Liveness: the static peak-live estimate must reproduce the memory
+# orderings the sharding designs promise.
+# ---------------------------------------------------------------------------
+
+def test_liveness_fields_positive(head_costs):
+    _, live = head_costs["train_step@dp8"]
+    for key in ("peak_live_bytes", "input_bytes", "donated_input_bytes",
+                "output_bytes", "body_eqns"):
+        assert live[key] > 0, (key, live)
+    assert live["peak_live_bytes"] >= live["output_bytes"]
+
+
+def test_tp_peak_live_below_dp8(head_costs):
+    # (2,4) tensor-parallel shards the model-sharded leaves /4: both the
+    # donated state and the peak must come in under pure 1-D data
+    # parallel on the same 8 devices.
+    tp, dp = head_costs["train_step@tp"][1], head_costs["train_step@dp8"][1]
+    assert tp["donated_input_bytes"] < dp["donated_input_bytes"]
+    assert tp["peak_live_bytes"] < dp["peak_live_bytes"]
+
+
+def test_zero_peak_live_below_nonzero(head_costs):
+    # ZeRO-1 shards the momentum buffers: less donated state, lower peak.
+    zero, base = (head_costs["train_step_zero@dp8"][1],
+                  head_costs["train_step@dp8"][1])
+    assert zero["donated_input_bytes"] < base["donated_input_bytes"]
+    assert zero["peak_live_bytes"] < base["peak_live_bytes"]
+    assert (head_costs["train_step_zero@tp"][1]["peak_live_bytes"]
+            < head_costs["train_step@tp"][1]["peak_live_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# Budget gate (synthetic tables — no tracing).
+# ---------------------------------------------------------------------------
+
+def _row(v=100):
+    return {m: v for m in BUDGET_METRICS}
+
+
+def test_budget_clean_within_tolerance():
+    budgets = make_budgets({"p": _row(100)}, "deepnn", (2, 4))
+    assert budgets["tolerance_pct"] == 10.0
+    assert check_budgets({"p": _row(109)}, budgets, "deepnn", (2, 4)) == []
+
+
+def test_budget_overrun_is_an_error():
+    budgets = make_budgets({"p": _row(100)}, "deepnn", (2, 4))
+    findings = check_budgets({"p": _row(111)}, budgets, "deepnn", (2, 4))
+    assert findings and all(f.check == "budget" for f in findings)
+    assert all(f.severity == "error" for f in findings)
+    assert len(findings) == len(BUDGET_METRICS)
+
+
+def test_budget_other_mesh_is_info_not_gate():
+    budgets = make_budgets({"p": _row(100)}, "deepnn", (2, 4))
+    findings = check_budgets({"p": _row(10**9)}, budgets, "deepnn", (1, 8))
+    assert [f.severity for f in findings] == ["info"]
+
+
+def test_budget_missing_program_warns_unless_partial():
+    budgets = make_budgets({"p": _row(), "gone": _row()}, "deepnn", (2, 4))
+    findings = check_budgets({"p": _row()}, budgets, "deepnn", (2, 4))
+    assert [f.severity for f in findings] == ["warning"]
+    assert check_budgets({"p": _row()}, budgets, "deepnn", (2, 4),
+                         partial=True) == []
+
+
+def test_budget_unbudgeted_program_warns():
+    budgets = make_budgets({"p": _row()}, "deepnn", (2, 4))
+    findings = check_budgets({"p": _row(), "new": _row()}, budgets,
+                             "deepnn", (2, 4))
+    assert [f.severity for f in findings] == ["warning"]
+    assert "no budget entry" in findings[0].detail
+
+
+def test_repo_budgets_file_matches_head(head_costs):
+    # BUDGETS.json at the repo root IS the head cost table (within
+    # tolerance) — the CI gate must be green at head.
+    path = os.path.join(os.path.dirname(PKG_ROOT), "BUDGETS.json")
+    with open(path, "r", encoding="utf-8") as fh:
+        budgets = json.load(fh)
+    table = {name: {**cost.as_json(), **live}
+             for name, (cost, live) in head_costs.items()}
+    findings = check_budgets(table, budgets, "deepnn", (2, 4))
+    assert [f for f in findings if f.severity == "error"] == [], findings
+
+
+# ---------------------------------------------------------------------------
+# Divergence lint (synthetic sources).
+# ---------------------------------------------------------------------------
+
+def test_divergence_rank_guarded_collective_flagged():
+    src = ("def f(x):\n"
+           "    if jax.process_index() == 0:\n"
+           "        return lax.psum(x, 'data')\n"
+           "    return x\n")
+    findings = divergence_scan("t.py", src)
+    assert len(findings) == 1 and findings[0].check == "divergence"
+    assert findings[0].severity == "error"
+    assert "psum" in findings[0].detail
+
+
+def test_divergence_annotation_is_honored():
+    src = ("def f(x):\n"
+           "    if jax.process_index() == 0:\n"
+           "        # analysis: divergence-ok(test)\n"
+           "        return lax.psum(x, 'data')\n"
+           "    return x\n")
+    assert divergence_scan("t.py", src) == []
+
+
+def test_divergence_uniform_guard_is_clean():
+    src = ("def f(x):\n"
+           "    multi = jax.process_count() > 1\n"
+           "    if multi:\n"
+           "        return lax.psum(x, 'data')\n"
+           "    return x\n")
+    assert divergence_scan("t.py", src) == []
+
+
+def test_divergence_collective_in_test_position_is_clean():
+    # The sanctioned shape: decide COLLECTIVELY, then branch.
+    src = ("def f(mesh, local):\n"
+           "    if _process_any(mesh, local):\n"
+           "        return 'stop'\n"
+           "    return 'go'\n")
+    assert divergence_scan("t.py", src) == []
+
+
+def test_divergence_early_exit_before_collective_flagged():
+    src = ("def f(x, q):\n"
+           "    if q.empty():\n"
+           "        return None\n"
+           "    return lax.psum(x, 'data')\n")
+    findings = divergence_scan("t.py", src)
+    assert len(findings) == 1
+    assert "early return" in findings[0].detail
+
+
+def test_divergence_except_handler_collective_flagged():
+    src = ("def f(x):\n"
+           "    try:\n"
+           "        y = load(x)\n"
+           "    except OSError:\n"
+           "        y = lax.pmax(x, 'data')\n"
+           "    return y\n")
+    findings = divergence_scan("t.py", src)
+    assert len(findings) == 1
+    assert "host-local" in findings[0].detail
+
+
+# ---------------------------------------------------------------------------
+# CLI artifact schema + plan-table cost column.
+# ---------------------------------------------------------------------------
+
+def test_cli_json_cost_table_schema(capsys, tmp_path):
+    art = tmp_path / "a.json"
+    assert cli_run(["--strict", "--programs", "train_step@dp8",
+                    "--skip-static", "--json", str(art)]) == 0
+    data = json.loads(art.read_text())
+    row = data["cost_table"]["train_step@dp8"]
+    for key in ("flops", "bytes", "flops_by_class", "collectives",
+                "collective_count", "collective_payload_bytes",
+                "unknown_trip_loops", "peak_live_bytes", "input_bytes",
+                "donated_input_bytes", "output_bytes", "body_eqns"):
+        assert key in row, key
+    assert row["flops"] > 0 and row["peak_live_bytes"] > 0
+    assert set(BUDGET_METRICS) <= set(row)
+
+
+def test_plan_table_cost_column_and_footer(head_audit):
+    ctx, _ = head_audit
+    from ddp_tpu.parallel.tp.plan import format_plan_table
+    costs = layer_forward_costs(ctx.model, ctx.plan, ctx.params, ctx.stats)
+    assert costs is not None and all(v > 0 for v in costs.values())
+    lines = format_plan_table(ctx.plan, layer_costs=costs).splitlines()
+    assert lines[1].split() == ["leaf", "style", "shape", "spec",
+                                "per-shard", "collectives", "fwd-mflop"]
+    assert lines[-3].startswith("total ")
+    assert lines[-2].startswith("predicted cost: fwd ")
+    assert lines[-1].startswith("expected collectives: psum(model) ")
+    # The per-layer cells sum to the per-model-shard footer total.
+    cells = [float(r.split()[-1]) for r in lines[2:-3]
+             if r.split()[-1] != "-"]
+    per_shard = float(lines[-2].split("|")[1].split()[0])
+    assert abs(sum(cells) - per_shard) < 0.05
+    # The unsharded footer total is the traced forward itself.
+    full = float(lines[-2].split("fwd")[1].split()[0])
+    assert abs(full - sum(costs.values()) / 1e6) < 0.01
+    # Without costs the legacy 6-column table is unchanged.
+    legacy = format_plan_table(ctx.plan).splitlines()
+    assert legacy[1].split()[-1] == "collectives"
+    assert legacy[-2].startswith("total ")
