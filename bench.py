@@ -254,6 +254,11 @@ def _parse_args():
                         "p50/p90/p99 latency + achieved throughput per "
                         "point and locating the saturation knee — the "
                         "latency-vs-load curve a capacity plan reads")
+    p.add_argument("--fleet", default=1, type=int, metavar="N",
+                   help="With --serve: drive N engine replicas behind "
+                        "the fault-tolerant router (serve/fleet.py) "
+                        "instead of one bare engine+batcher — the "
+                        "knee-vs-N scaling record (default 1)")
     p.add_argument("--serve_loads", default="auto", metavar="R1,R2,...",
                    help="Offered loads (requests/sec) for the open-loop "
                         "sweep; 'auto' derives 4 points bracketing the "
@@ -807,26 +812,35 @@ def _bench_serve(args) -> None:
     """
     import threading
 
-    from ddp_tpu.serve import DynamicBatcher, QueueFull, ServeEngine
+    from ddp_tpu.serve import (DynamicBatcher, LocalReplica, QueueFull,
+                               Router, ServeEngine)
     from ddp_tpu.serve.batcher import percentiles
 
     mesh = make_mesh(args.num_devices)
     model = get_model(args.model)
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     buckets = [int(b) for b in args.serve_buckets.split(",") if b]
-    if args.snapshot_path:
-        engine = ServeEngine.from_checkpoint(
-            args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
-            compute_dtype=compute_dtype)
-    else:
+    fleet_n = max(int(args.fleet), 1)
+
+    def make_engine() -> "ServeEngine":
+        if args.snapshot_path:
+            return ServeEngine.from_checkpoint(
+                args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
+                compute_dtype=compute_dtype)
         params, stats = model.init(jax.random.key(0))
-        engine = ServeEngine(model, params, stats, mesh, buckets=buckets,
-                             compute_dtype=compute_dtype)
+        return ServeEngine(model, params, stats, mesh, buckets=buckets,
+                           compute_dtype=compute_dtype)
+
     t0 = time.perf_counter()
-    compiled = engine.warm()
+    engines = [make_engine() for _ in range(fleet_n)]
+    engine = engines[0]
+    compiled = 0
+    for eng in engines:
+        c = eng.warm()
+        assert c <= len(eng.buckets), \
+            f"compile bound broken: {c} > {len(eng.buckets)}"
+        compiled += c
     warm_s = time.perf_counter() - t0
-    assert compiled <= len(engine.buckets), \
-        f"compile bound broken: {compiled} > {len(engine.buckets)}"
     if not 1 <= args.serve_rows <= engine.max_rows:
         # Fail HERE with the real reason: inside the load loops the same
         # admission error would kill every client thread and surface as
@@ -835,8 +849,20 @@ def _bench_serve(args) -> None:
             f"--serve_rows {args.serve_rows} does not fit the engine's "
             f"buckets (largest {engine.max_rows}); every request would "
             "be rejected at admission")
-    batcher = DynamicBatcher(engine, max_wait_ms=args.serve_max_wait_ms,
-                             queue_depth=args.serve_queue_depth).start()
+    batchers = [DynamicBatcher(eng, max_wait_ms=args.serve_max_wait_ms,
+                               queue_depth=args.serve_queue_depth).start()
+                for eng in engines]
+    router = None
+    if fleet_n > 1:
+        # Fleet mode: the same load loops drive the router's submit —
+        # QueueFull below also catches the router's shed subclasses, so
+        # shed accounting is transport-identical to single-engine mode.
+        replicas = [LocalReplica(f"r{i}", eng, b)
+                    for i, (eng, b) in enumerate(zip(engines, batchers))]
+        router = Router(replicas).start()
+        submit = router.submit
+    else:
+        submit = batchers[0].submit
     rng = np.random.default_rng(0)
     req = rng.integers(0, 256,
                        (args.serve_rows, 32, 32, 3)).astype(np.uint8)
@@ -854,7 +880,7 @@ def _bench_serve(args) -> None:
             while time.perf_counter() < stop:
                 t = time.perf_counter()
                 try:
-                    batcher.submit(req, timeout=30)
+                    submit(req, timeout=30)
                 except TimeoutError:
                     with lock:
                         timeouts[0] += 1
@@ -898,7 +924,7 @@ def _bench_serve(args) -> None:
                     time.sleep(delay)
                 t = time.perf_counter()
                 try:
-                    batcher.submit(req, timeout=30)
+                    submit(req, timeout=30)
                 except QueueFull:
                     with lock:
                         shed += 1
@@ -922,6 +948,7 @@ def _bench_serve(args) -> None:
         return {"offered_rps": round(rate, 2), "requests": n,
                 "achieved_rps": round(len(lat) / wall, 2),
                 "shed": shed,
+                "shed_rate": round(shed / n, 4),
                 "timed_out": timed_out,
                 "latency_ms": {k: (round(v, 3) if v is not None else None)
                                for k, v in percentiles(lat).items()}}
@@ -956,7 +983,8 @@ def _bench_serve(args) -> None:
     # would poison cross-round BENCH comparisons.
     print(json.dumps({
         "metric": f"{args.model} serve latency/throughput vs offered load "
-                  f"(batch buckets {list(engine.buckets)}, "
+                  f"(fleet of {fleet_n}, "
+                  f"batch buckets {list(engine.buckets)}, "
                   f"{rows_per_req} row(s)/request, "
                   f"{'bf16' if args.bf16 else 'fp32'}, "
                   f"{mesh.devices.size} chip(s), max_wait "
@@ -969,6 +997,7 @@ def _bench_serve(args) -> None:
                       "degraded; not comparable to knee records)"),
         "vs_baseline": 1.0,
         "serve": {
+            "fleet": fleet_n,
             "closed_loop": closed,
             "open_loop": open_points,
             "knee_offered_rps": (knee or {}).get("offered_rps"),
@@ -979,10 +1008,14 @@ def _bench_serve(args) -> None:
             "bucket_set_size": len(engine.buckets),
             "warm_compile_s": round(warm_s, 2),
             "engine": engine.stats(),
-            "batcher": batcher.stats(),
+            "batcher": batchers[0].stats(),
+            "router": router.stats() if router is not None else None,
         },
     }))
-    batcher.drain(timeout=10.0)
+    if router is not None:
+        router.close()
+    for b in batchers:
+        b.drain(timeout=10.0)
 
 
 def _bench_sweep(args) -> None:

@@ -45,6 +45,7 @@ from .findings import Finding, make_finding
 
 # Modules whose classes are held to the lockset discipline.
 SCAN_MODULES = ("data/prefetch.py", "serve/batcher.py", "serve/engine.py",
+                "serve/router.py", "serve/fleet.py",
                 "train/trainer.py", "train/checkpoint.py",
                 "resilience/watchdog.py")
 

@@ -36,7 +36,14 @@ from typing import Dict, Iterable, List, Optional, Tuple
 # tracer accepts free-form names).
 PHASE_ORDER = ("data_wait", "host_augment", "h2d", "dispatch",
                "loss_flush", "ckpt_write", "eval",
-               "queue_wait", "batch_form", "pad", "forward", "d2h")
+               "queue_wait", "batch_form", "pad", "forward", "d2h",
+               # Fleet/router phases (serve/router.py, serve/fleet.py):
+               # route/retry are per-request handler-thread spans
+               # (overlap=True); eject/readmit mark rotation changes and
+               # swap_warm/swap_commit bracket a checkpoint hot-swap —
+               # none is per-step (a request is not a batch sequence).
+               "route", "retry", "eject", "readmit",
+               "swap_warm", "swap_commit")
 
 # Phases attributable to ONE step each — the per-step wall decomposition
 # the histogram and slowest-K tables are built from.  Boundary phases

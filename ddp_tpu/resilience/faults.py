@@ -16,16 +16,34 @@ Faults:
   ``stall_at_epoch``    put one rank to sleep at the end of epoch k — the
                         hung-peer scenario the watchdog bounds
 
+Serve-side faults (the fleet chaos drills — tests/test_fleet.py and the
+CI fleet smoke):
+  ``crash_replica_at_request_n``  one replica dies permanently at its
+                        n-th request: submits fail fast AND health
+                        probes fail, so the router retries the request
+                        elsewhere and then ejects the replica
+  ``slow_forward_ms``   every request on one replica takes ms longer —
+                        the straggler/overload scenario the deadline
+                        budget and least-loaded routing bound
+  ``torn_publish``      truncate the newest published head right before
+                        the fleet's hot-swap watcher loads it, once —
+                        drives the named ``swap_skipped`` path
+
 Env surface for subprocess drills (``DDP_TPU_FAULT``): semicolon-separated
 specs ``kind@key=val,key=val`` — e.g.
 ``sigterm@epoch=1``, ``poison@step=5``,
-``stall@epoch=0,rank=1,secs=600``.
+``stall@epoch=0,rank=1,secs=600``.  Serve processes
+(``python -m ddp_tpu.serve --fleet N``) parse the same variable through
+:func:`install_serve_faults` with the serve vocabulary:
+``crash_replica@requests=25,replica=0``, ``slow_forward@ms=200,replica=1``,
+``torn_publish@``.
 """
 from __future__ import annotations
 
 import os
 import signal
 import sys
+import threading
 import time
 from typing import Optional
 
@@ -103,6 +121,97 @@ def stall_at_epoch(trainer, epoch: int, seconds: float,
             time.sleep(seconds)
 
     _after_epoch(trainer, fire)
+
+
+def crash_replica_at_request_n(replica, n: int) -> None:
+    """Replica ``replica`` dies permanently at its ``n``-th submit: the
+    latched ``crashed`` flag makes every later submit AND health probe
+    fail, so the router both retries the victim request elsewhere and
+    (after ``eject_after`` probes) ejects the replica from rotation —
+    the closest in-process model of a killed serve process."""
+    orig = replica.submit
+    lock = threading.Lock()
+    count = [0]
+
+    def wrapped(images, timeout=None):
+        with lock:
+            count[0] += 1
+            c = count[0]
+        if c >= n:
+            if not replica.crashed:
+                print(f"[fault] replica {replica.replica_id} crashing at "
+                      f"request {c}", file=sys.stderr)
+                sys.stderr.flush()
+            replica.crashed = True
+        return orig(images, timeout=timeout)
+
+    replica.submit = wrapped
+
+
+def slow_forward_ms(replica, ms: float) -> None:
+    """Every submit on ``replica`` takes ``ms`` extra — a straggling
+    replica the least-loaded routing should steer around and the
+    per-request deadline budget must bound."""
+    orig = replica.submit
+    delay_s = float(ms) / 1e3
+
+    def wrapped(images, timeout=None):
+        time.sleep(delay_s)
+        return orig(images, timeout=timeout)
+
+    replica.submit = wrapped
+
+
+def torn_publish(fleet) -> None:
+    """Truncate the resolved head file right before the fleet's NEXT
+    snapshot load (once) — the watcher's full lineage walk must then
+    skip the publish with a named ``swap_skipped`` event and keep
+    serving the current snapshot."""
+    orig = fleet._load_snapshot
+    fired = [False]
+
+    def wrapped():
+        if not fired[0]:
+            fired[0] = True
+            from .lineage import _resolve_head
+            head = _resolve_head(fleet.snapshot_path)
+            if os.path.exists(head):
+                print(f"[fault] tearing published head {head!r} before "
+                      "the watcher loads it", file=sys.stderr)
+                sys.stderr.flush()
+                tear_file(head)
+        return orig()
+
+    fleet._load_snapshot = wrapped
+
+
+def install_serve_faults(fleet) -> None:
+    """Apply :data:`FAULT_ENV` serve-fault specs to ``fleet`` (the serve
+    process's counterpart of :func:`install_env_faults`; no-op when the
+    variable is unset).  Specs use the serve vocabulary only — a serve
+    process given a trainer spec is a drill wiring error and fails
+    loudly."""
+    spec = os.environ.get(FAULT_ENV)
+    if not spec:
+        return
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, argstr = part.partition("@")
+        kv = dict(a.split("=", 1) for a in argstr.split(",") if a)
+        if kind == "crash_replica":
+            idx = int(kv.get("replica", "0"))
+            crash_replica_at_request_n(fleet.replicas[idx],
+                                       int(kv["requests"]))
+        elif kind == "slow_forward":
+            idx = int(kv.get("replica", "0"))
+            slow_forward_ms(fleet.replicas[idx], float(kv["ms"]))
+        elif kind == "torn_publish":
+            torn_publish(fleet)
+        else:
+            raise ValueError(f"unknown {FAULT_ENV} serve fault kind "
+                             f"{kind!r} in {part!r}")
 
 
 def install_env_faults(trainer) -> None:

@@ -359,6 +359,36 @@ def latest_verifiable(
         + "; ".join(f"{fp!r}: {why}" for fp, why in tried))
 
 
+def head_fingerprint(path: Optional[str]):
+    """Cheap publish-change detector for checkpoint watchers (the serve
+    fleet's hot-swap poller): a hashable token that changes whenever a
+    new head lands under ``path``, WITHOUT reading checkpoint bytes.
+
+    Reads only the ~1 KB manifest (epoch/step/sha of the head) when one
+    exists; a manifest-less head degrades to its stat signature.  Returns
+    ``None`` when nothing resolvable exists yet — callers poll again.
+    A fingerprint change is a *hint* to run the full (expensive, sha-
+    verified) :func:`latest_verifiable` walk, never a load decision by
+    itself: a torn head changes the fingerprint too, and the walk is
+    what falls back / skips it.
+    """
+    if not path:
+        return None
+    try:
+        head = _resolve_head(path)
+    except CheckpointError:
+        return None
+    m = read_manifest(head)
+    if m is not None and isinstance(m.get("head"), dict):
+        h = m["head"]
+        return ("manifest", h.get("epoch"), h.get("step"), h.get("sha256"))
+    try:
+        st = os.stat(head)
+    except OSError:
+        return None
+    return ("stat", st.st_mtime_ns, st.st_size, None)
+
+
 # Historical name (rounds 5-7); the trainer and serve engine both call
 # latest_verifiable now, but external embedders may hold this spelling.
 load_latest_verifiable = latest_verifiable

@@ -18,10 +18,26 @@ This package is that path, built from the same parts training runs on:
                (whichever first), explicit backpressure
                (:class:`QueueFull`) instead of unbounded latency,
                graceful drain for shutdown.
+- ``router``   :class:`Router` — the fleet's fault-tolerance layer:
+               health-driven ejection with exponential-backoff
+               re-admission, per-request deadline budgets with bounded
+               jittered retries, a per-replica circuit breaker
+               (consecutive-failure trip, half-open single probe), and
+               router-level shedding with ``Retry-After`` derived from
+               live queue depth.
+- ``fleet``    :class:`ServeFleet` — N warmed engine replicas
+               (:class:`LocalReplica` in-process pairs and/or
+               :class:`HTTPReplica` remote backends) behind the router,
+               plus the zero-downtime checkpoint hot-swap watcher
+               (``lineage.head_fingerprint`` poll → verified load →
+               ``swap_warm`` AOT compile → atomic ``swap_commit``;
+               torn publishes skipped with a named event).
 - ``http``     stdlib-only threaded HTTP front end: ``/predict``,
-               ``/healthz``, ``/stats``.
+               ``/healthz``, ``/stats`` — fronting one engine or a
+               whole fleet; idempotent ``close()``.
 - ``__main__`` ``python -m ddp_tpu.serve`` — stand the stack up on a
-               checkpoint; SIGTERM drains via the resilience preemption
+               checkpoint (``--fleet N`` for the router + hot-swap
+               stack); SIGTERM drains via the resilience preemption
                guard.
 
 Every stage (queue_wait, batch_form, pad, h2d, forward, d2h) records
@@ -33,10 +49,15 @@ percentiles vs offered load, saturation knee).
 from .batcher import Draining, DynamicBatcher, QueueFull, percentiles
 from .engine import (RequestTooLarge, ServeEngine, ServeError,
                      resolve_buckets)
+from .fleet import HTTPReplica, LocalReplica, ServeFleet
 from .http import ServeHTTPServer
+from .router import (CircuitBreaker, NoHealthyReplicas, ReplicaCrashed,
+                     Router, RouterOverloaded, RouterShed)
 
 __all__ = [
-    "Draining", "DynamicBatcher", "QueueFull", "RequestTooLarge",
-    "ServeEngine", "ServeError", "ServeHTTPServer", "percentiles",
-    "resolve_buckets",
+    "CircuitBreaker", "Draining", "DynamicBatcher", "HTTPReplica",
+    "LocalReplica", "NoHealthyReplicas", "QueueFull", "ReplicaCrashed",
+    "RequestTooLarge", "Router", "RouterOverloaded", "RouterShed",
+    "ServeEngine", "ServeError", "ServeFleet", "ServeHTTPServer",
+    "percentiles", "resolve_buckets",
 ]

@@ -68,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Admission queue bound; a full queue sheds with "
                         "503 instead of queueing into unbounded latency "
                         "(default 256 requests)")
+    p.add_argument("--fleet", default=0, type=int, metavar="N",
+                   help="Serve N in-process engine replicas behind the "
+                        "fault-tolerant router (health-driven ejection, "
+                        "retry budgets, circuit breakers) instead of one "
+                        "bare engine; 0 = single-engine mode (default)")
+    p.add_argument("--swap_poll_s", default=0.0, type=float,
+                   help="Fleet only: poll the checkpoint lineage every "
+                        "this many seconds and hot-swap newly published "
+                        "verifiable snapshots into rotation with zero "
+                        "downtime (0 disables the watcher; default 0)")
     p.add_argument("--bf16", action="store_true",
                    help="Serve in bfloat16 compute (match the flag the "
                         "checkpoint was trained with for parity)")
@@ -93,9 +103,11 @@ def main(argv: Optional[list] = None) -> int:
 
     from ..obs.tracer import NullTracer, SpanTracer, set_tracer
     from ..parallel.mesh import make_mesh
+    from ..resilience.faults import install_serve_faults
     from ..resilience.preemption import PreemptionGuard
     from .batcher import DynamicBatcher
     from .engine import ServeEngine
+    from .fleet import ServeFleet
     from .http import ServeHTTPServer
 
     if args.obs_off:
@@ -105,37 +117,57 @@ def main(argv: Optional[list] = None) -> int:
                             ring=65536, host=0)
     mesh = make_mesh(args.num_devices)
     buckets = [int(b) for b in args.buckets.split(",") if b]
+    compute_dtype = jnp.bfloat16 if args.bf16 else None
     try:
         set_tracer(tracer)
         print(f"loading newest verifiable checkpoint under "
               f"{args.snapshot_path!r} ...", file=sys.stderr)
-        engine = ServeEngine.from_checkpoint(
-            args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
-            compute_dtype=jnp.bfloat16 if args.bf16 else None,
-            tracer=tracer)
-        t0 = time.monotonic()
-        compiled = engine.warm()
-        print(f"compiled {compiled} bucket executable(s) "
-              f"{list(engine.buckets)} in {time.monotonic() - t0:.1f}s "
-              f"(checkpoint {engine.checkpoint_file!r}, epoch "
-              f"{engine.checkpoint_epoch}); no request pays a compile",
-              file=sys.stderr)
-        batcher = DynamicBatcher(engine, max_batch=args.max_batch,
-                                 max_wait_ms=args.max_wait_ms,
-                                 queue_depth=args.queue_depth,
-                                 tracer=tracer).start()
-        httpd = ServeHTTPServer((args.host, args.port), engine, batcher)
+        fleet = engine = batcher = None
+        if args.fleet >= 1:
+            t0 = time.monotonic()
+            fleet = ServeFleet(
+                args.snapshot_path, args.model, mesh=mesh,
+                n_replicas=args.fleet, buckets=buckets,
+                compute_dtype=compute_dtype, max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                queue_depth=args.queue_depth, tracer=tracer)
+            install_serve_faults(fleet)
+            fleet.start(poll_s=args.swap_poll_s)
+            print(f"warmed {args.fleet} replica(s) in "
+                  f"{time.monotonic() - t0:.1f}s (checkpoint step "
+                  f"{fleet.health()['checkpoint_step']}; hot-swap watcher "
+                  f"{'every %.1fs' % args.swap_poll_s if args.swap_poll_s > 0 else 'off'})",
+                  file=sys.stderr)
+            httpd = ServeHTTPServer((args.host, args.port), fleet=fleet)
+        else:
+            engine = ServeEngine.from_checkpoint(
+                args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
+                compute_dtype=compute_dtype, tracer=tracer)
+            t0 = time.monotonic()
+            compiled = engine.warm()
+            print(f"compiled {compiled} bucket executable(s) "
+                  f"{list(engine.buckets)} in {time.monotonic() - t0:.1f}s "
+                  f"(checkpoint {engine.checkpoint_file!r}, epoch "
+                  f"{engine.checkpoint_epoch}); no request pays a compile",
+                  file=sys.stderr)
+            batcher = DynamicBatcher(engine, max_batch=args.max_batch,
+                                     max_wait_ms=args.max_wait_ms,
+                                     queue_depth=args.queue_depth,
+                                     tracer=tracer).start()
+            httpd = ServeHTTPServer((args.host, args.port), engine, batcher)
         listener = threading.Thread(target=httpd.serve_forever,
                                     daemon=True, name="serve-http")
         listener.start()
         # Graceful drain on SIGTERM/SIGINT — the same resilience guard
         # the trainer uses for preemption (main-thread only; under a
-        # non-main-thread embedder, stop via batcher.drain()+shutdown()).
+        # non-main-thread embedder, stop via drain()/close()+close()).
         guard = (PreemptionGuard().install()
                  if threading.current_thread() is threading.main_thread()
                  else None)
         host, port = httpd.server_address[:2]
-        print(f"serving {args.model} on http://{host}:{port} "
+        what = (f"{args.model} fleet of {args.fleet}" if fleet is not None
+                else args.model)
+        print(f"serving {what} on http://{host}:{port} "
               "(/predict /healthz /stats); SIGTERM drains gracefully",
               flush=True)
         try:
@@ -145,12 +177,17 @@ def main(argv: Optional[list] = None) -> int:
             pass  # second Ctrl-C during shutdown lands here; drain anyway
         print("draining: admission stopped, serving accepted requests ...",
               file=sys.stderr)
-        drained = batcher.drain(timeout=30.0)
-        httpd.shutdown()
-        httpd.server_close()
+        if fleet is not None:
+            drained = fleet.close(timeout=30.0)
+        else:
+            drained = batcher.drain(timeout=30.0)
+        # Idempotent listener teardown: a second SIGTERM racing this
+        # shutdown may have already closed it — close() absorbs that.
+        httpd.close()
         if guard is not None:
             guard.uninstall()
-        stats = {"engine": engine.stats(), "batcher": batcher.stats()}
+        stats = (fleet.stats() if fleet is not None else
+                 {"engine": engine.stats(), "batcher": batcher.stats()})
         print(json.dumps(stats), file=sys.stderr)
         print(f"drained={'clean' if drained else 'FORCED'}; bye",
               file=sys.stderr)

@@ -325,6 +325,12 @@ class DynamicBatcher:
     def draining(self) -> bool:
         return self._draining.is_set()
 
+    def queue_depth(self) -> int:
+        """Live admission-queue depth (requests accepted, not yet formed
+        into a batch) — the router's least-loaded routing key and the
+        ``Retry-After`` input; also on /healthz."""
+        return self._q.qsize()
+
     # -- introspection -----------------------------------------------------
 
     def stats(self) -> dict:

@@ -10,17 +10,30 @@ the work, which is exactly the dynamic batcher's concurrency model):
   status codes: 400 malformed, 413 oversized (larger than the biggest
   bucket), 503 shed/draining with ``Retry-After`` — backpressure the
   client can act on, never an unbounded queue.
-- ``GET /healthz``   liveness + which checkpoint is live; flips to
+- ``GET /healthz``   liveness + which checkpoint is live, plus the
+  identity fields a fleet router keys on: ``replica_id``,
+  ``checkpoint_step``, ``uptime_s``, ``queue_depth``; flips to
   ``"draining"`` (503) during graceful shutdown so load balancers stop
   routing before the listener closes.
 - ``GET /stats``     engine + batcher counters (bucket usage, latency
-  percentiles, shed counts, compiled-executable count).
+  percentiles, shed counts, compiled-executable count) and the swap
+  history (``swaps`` — every hot-swap commit/skip; empty list on a
+  single-engine server, which has no swap machinery).
+
+The same listener fronts either backend: a single (engine, batcher)
+pair, or a :class:`~ddp_tpu.serve.fleet.ServeFleet` (pass ``fleet=``) —
+the handler calls the server's ``submit``/``healthz_payload``/
+``stats_payload`` indirection, so the router's shed errors (which carry
+a derived ``retry_after_s``) map onto 503 + ``Retry-After`` exactly
+like the batcher's.
 """
 from __future__ import annotations
 
 import json
+import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -44,12 +57,80 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
     daemon_threads = True
 
-    def __init__(self, addr, engine: ServeEngine, batcher: DynamicBatcher,
-                 quiet: bool = True):
+    def __init__(self, addr, engine: Optional[ServeEngine] = None,
+                 batcher: Optional[DynamicBatcher] = None,
+                 quiet: bool = True, *, fleet=None,
+                 replica_id: str = "r0"):
+        if fleet is None and (engine is None or batcher is None):
+            raise ValueError(
+                "ServeHTTPServer needs either (engine, batcher) or fleet=")
         self.engine = engine
         self.batcher = batcher
+        self.fleet = fleet
+        self.replica_id = replica_id
         self.quiet = quiet
+        self._t0 = time.monotonic()
+        # close() latch: signal handlers and drain paths both call it;
+        # the second (and every later) call must be a no-op, and calling
+        # shutdown() on a listener whose serve_forever never ran would
+        # block forever (stdlib wait-for-event), hence _started.
+        self._closed = threading.Event()
+        self._started = threading.Event()
         super().__init__(addr, _Handler)
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        self._started.set()
+        super().serve_forever(poll_interval)
+
+    def close(self) -> None:
+        """Idempotent listener teardown, safe to call twice and from a
+        signal handler: first call stops ``serve_forever`` (if it ever
+        ran) and closes the socket; every later call returns at once.
+        Draining the batcher/fleet stays the caller's step — close()
+        only guarantees the LISTENER can always be torn down exactly
+        once, whatever order signals arrive in."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        if self._started.is_set():
+            try:
+                self.shutdown()
+            except Exception:
+                pass  # already stopping; teardown must not raise
+        try:
+            self.server_close()
+        except OSError:
+            pass  # socket already closed
+
+    # -- backend indirection (single pair vs fleet) ------------------------
+
+    def submit(self, images: np.ndarray, timeout: float) -> np.ndarray:
+        if self.fleet is not None:
+            return self.fleet.submit(images, timeout=timeout)
+        return self.batcher.submit(images, timeout=timeout)
+
+    def healthz_payload(self) -> Tuple[int, dict]:
+        if self.fleet is not None:
+            h = self.fleet.health()
+            return (200 if h["status"] == "ok" else 503), h
+        draining = self.batcher.draining
+        return 503 if draining else 200, {
+            "status": "draining" if draining else "ok",
+            "replica_id": self.replica_id,
+            "checkpoint_step": getattr(self.engine, "checkpoint_step", None),
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "queue_depth": self.batcher.queue_depth(),
+            "buckets": list(self.engine.buckets),
+            "compiled_executables": self.engine.trace_count,
+            "checkpoint": self.engine.stats()["checkpoint"],
+        }
+
+    def stats_payload(self) -> dict:
+        if self.fleet is not None:
+            return self.fleet.stats()
+        return {"engine": self.engine.stats(),
+                "batcher": self.batcher.stats(),
+                "swaps": []}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -85,16 +166,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib naming
         if self.path == "/healthz":
-            draining = self.server.batcher.draining
-            self._reply(503 if draining else 200, {
-                "status": "draining" if draining else "ok",
-                "buckets": list(self.server.engine.buckets),
-                "compiled_executables": self.server.engine.trace_count,
-                "checkpoint": self.server.engine.stats()["checkpoint"],
-            })
+            status, payload = self.server.healthz_payload()
+            self._reply(status, payload)
         elif self.path == "/stats":
-            self._reply(200, {"engine": self.server.engine.stats(),
-                              "batcher": self.server.batcher.stats()})
+            self._reply(200, self.server.stats_payload())
         else:
             self._reply(404, {"error": f"no route {self.path!r}; try "
                                        "/predict, /healthz, /stats"})
@@ -130,13 +205,17 @@ class _Handler(BaseHTTPRequestHandler):
                     "pixel values must be integers in [0, 255] (uint8 — "
                     "the training loaders' wire format)")
             images = images.astype(np.uint8)
-            logits = self.server.batcher.submit(
-                images, timeout=REQUEST_TIMEOUT_S)
+            logits = self.server.submit(images, timeout=REQUEST_TIMEOUT_S)
         except RequestTooLarge as e:
             self._reply(413, {"error": str(e)})
             return
         except (QueueFull, Draining) as e:
-            self._reply(503, {"error": str(e)}, retry_after=1)
+            # Router sheds carry a retry_after_s derived from live queue
+            # depth / re-admission ETA; plain batcher backpressure keeps
+            # the fixed 1 s hint.
+            self._reply(503, {"error": str(e)},
+                        retry_after=max(
+                            1, round(getattr(e, "retry_after_s", 1))))
             return
         except (ValueError, TypeError) as e:
             self._reply(400, {"error": str(e)})

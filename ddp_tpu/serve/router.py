@@ -1,0 +1,540 @@
+"""Front-end request router: the fleet's fault-tolerance layer.
+
+One :class:`Router` load-balances N *replica handles* (in-process
+:class:`~ddp_tpu.serve.fleet.LocalReplica` pairs and/or
+:class:`~ddp_tpu.serve.fleet.HTTPReplica` backends — anything with the
+small protocol documented on :class:`Router`).  A single ``ServeEngine``
+behind one HTTP listener (PR 8's stack) turns every replica-level
+incident into shed traffic: one crashed replica, one stalled forward, or
+one checkpoint reload and clients see errors.  The router absorbs those
+incidents with three mechanisms, each bounded and observable:
+
+- **Health-driven ejection.**  A background probe thread polls every
+  replica's health; ``eject_after`` consecutive failures eject it from
+  rotation (an ``eject`` span + stderr event), and an ejected replica is
+  re-probed on an exponential backoff until it answers again
+  (``readmit`` span).  Routing never waits on a dead replica's TCP
+  timeout — the probe thread pays that cost off the request path.
+
+- **Retry with a deadline budget.**  Every request carries one deadline;
+  a replica failure consumes one of ``max_retries`` bounded retries with
+  jittered exponential backoff (a ``retry`` span), the breaker below is
+  informed, and no attempt — first or retried — ever waits past the
+  request's remaining budget.  There is no retry storm: the budget is
+  per-request and spent attempts never revive.
+
+- **Per-replica circuit breaker.**  ``breaker_trip_after`` consecutive
+  failures trip the replica's breaker OPEN; after a cooldown it goes
+  HALF-OPEN and admits *exactly one* probe request — success closes it,
+  failure re-opens with a doubled (capped) cooldown.  The breaker
+  reacts at request latency; the health prober at probe latency — a
+  replica that fails requests but still answers health probes is
+  contained by the breaker alone.
+
+Graceful degradation: when nothing can take the request the router
+sheds it *immediately* with a machine-actionable hint instead of letting
+it time out — :class:`NoHealthyReplicas` (everything ejected/open, retry
+after the soonest re-admission probe) or :class:`RouterOverloaded`
+(every healthy replica's admission queue full, retry after the live
+backlog drains at the measured service rate).  Both carry
+``retry_after_s`` and subclass :class:`~ddp_tpu.serve.batcher.QueueFull`
+so the HTTP layer's 503 + ``Retry-After`` mapping and bench.py's shed
+accounting apply unchanged.
+
+Telemetry: ``route`` (replica selection, per routed attempt) and
+``retry`` (the backoff wait) are ``overlap=True`` handler-thread spans;
+``eject``/``readmit`` mark rotation changes — all visible in
+``python -m ddp_tpu.obs`` and the Perfetto export next to the engine's
+pad/h2d/forward/d2h pipeline.
+"""
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.tracer import get_tracer
+from .batcher import Draining, QueueFull
+from .engine import RequestTooLarge, ServeError
+
+
+class ReplicaCrashed(ServeError):
+    """A replica died mid-request (process gone, engine wedged, fault
+    injection) — retryable on another replica, breaker-countable."""
+
+
+class RouterShed(QueueFull):
+    """Shed at the ROUTER with a derived ``Retry-After`` — subclasses
+    :class:`QueueFull` so every existing 503-with-backpressure mapping
+    (http.py, bench.py load loops) treats it as a shed, never a failure."""
+
+    def __init__(self, msg: str, retry_after_s: float):
+        super().__init__(msg)
+        self.retry_after_s = max(float(retry_after_s), 1.0)
+
+
+class NoHealthyReplicas(RouterShed):
+    """Every replica is ejected or breaker-open; ``retry_after_s`` is the
+    soonest re-admission probe."""
+
+
+class RouterOverloaded(RouterShed):
+    """Every healthy replica's admission queue is full; ``retry_after_s``
+    is the live backlog divided by the measured service rate."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit: CLOSED -> OPEN -> HALF-OPEN -> CLOSED.
+
+    ``allow()`` is the gate the router consults per attempt: always True
+    when CLOSED; False while OPEN (until the cooldown expires); in
+    HALF-OPEN it returns True exactly once (the single probe) and False
+    until that probe's outcome is recorded.  A failure while HALF-OPEN
+    (or ``trip_after`` consecutive failures while CLOSED) re-opens with
+    an exponentially doubled cooldown, capped at ``cooldown_max_s``;
+    any success snaps back to CLOSED and resets the backoff.
+    """
+
+    def __init__(self, trip_after: int = 3, cooldown_s: float = 1.0,
+                 cooldown_max_s: float = 30.0):
+        if trip_after < 1:
+            raise ValueError(f"trip_after must be >= 1, got {trip_after}")
+        self._lock = threading.Lock()
+        self._base_cooldown_s = float(cooldown_s)
+        self._cooldown_max_s = float(cooldown_max_s)
+        self.trip_after = int(trip_after)
+        self.state = "closed"           # analysis: shared-under(_lock)
+        self.failures = 0               # analysis: shared-under(_lock)
+        self.trips = 0                  # analysis: shared-under(_lock)
+        # analysis: shared-under(_lock)
+        self._cooldown_s = float(cooldown_s)
+        self._open_until = 0.0          # analysis: shared-under(_lock)
+        self._probe_out = False         # analysis: shared-under(_lock)
+
+    def allow(self) -> bool:
+        """May a request go to this replica NOW?  Claims the single
+        half-open probe slot when it grants one."""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            if self.state == "open":
+                if time.monotonic() < self._open_until:
+                    return False
+                self.state = "half-open"
+                self._probe_out = False
+            # half-open: exactly one in-flight probe.
+            if self._probe_out:
+                return False
+            self._probe_out = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = "closed"
+            self.failures = 0
+            self._probe_out = False
+            self._cooldown_s = self._base_cooldown_s
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.failures += 1
+            if self.state == "half-open" or (
+                    self.state == "closed"
+                    and self.failures >= self.trip_after):
+                self.state = "open"
+                self._open_until = time.monotonic() + self._cooldown_s
+                self._cooldown_s = min(self._cooldown_s * 2.0,
+                                       self._cooldown_max_s)
+                self._probe_out = False
+                self.trips += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"state": self.state, "failures": self.failures,
+                    "trips": self.trips,
+                    "cooldown_s": round(self._cooldown_s, 3)}
+
+
+class _ReplicaState:
+    """Router-side bookkeeping for one replica handle (no thread of its
+    own; every field is touched under the owning Router's ``_lock``)."""
+
+    def __init__(self, replica, breaker: CircuitBreaker):
+        self.replica = replica
+        self.breaker = breaker
+        self.ejected = False
+        self.health_failures = 0
+        self.ejections = 0
+        self.readmit_at = 0.0           # monotonic; next probe time
+        self.readmit_backoff_s = 0.0
+        self.served = 0
+        self.failed = 0
+
+
+class Router:
+    """Load balancer + failure absorber over a fixed replica set.
+
+    Replica protocol (duck-typed; LocalReplica/HTTPReplica implement it):
+
+    - ``replica_id``            stable string id
+    - ``submit(images, timeout=...)``  -> logits (raises ServeError/...)
+    - ``health()``              -> dict with ``status`` (raises when dead)
+    - ``queue_depth()``         -> int (requests waiting at admission)
+    - ``stats()``               -> dict (for /stats aggregation)
+
+    ``submit`` is the one request entry point, thread-safe; the health
+    prober runs on an internal daemon thread between :meth:`start` and
+    :meth:`close` (tests may instead call :meth:`health_tick` directly
+    for determinism).
+    """
+
+    def __init__(self, replicas, *, max_retries: int = 2,
+                 backoff_ms: float = 25.0,
+                 default_timeout_s: float = 30.0,
+                 health_interval_s: float = 0.5,
+                 eject_after: int = 2,
+                 readmit_base_s: float = 0.5,
+                 readmit_max_s: float = 30.0,
+                 breaker_trip_after: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 tracer=None, seed: int = 0):
+        replicas = list(replicas)
+        if not replicas:
+            raise ValueError("a router needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.max_retries = max(int(max_retries), 0)
+        self.backoff_s = max(float(backoff_ms), 0.0) / 1e3
+        self.default_timeout_s = float(default_timeout_s)
+        self.health_interval_s = float(health_interval_s)
+        self.eject_after = max(int(eject_after), 1)
+        self.readmit_base_s = float(readmit_base_s)
+        self.readmit_max_s = float(readmit_max_s)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._rng = random.Random(seed)   # analysis: shared-under(_lock)
+        self._lock = threading.Lock()
+        self._states: Dict[str, _ReplicaState] = {
+            rid: _ReplicaState(r, CircuitBreaker(
+                trip_after=breaker_trip_after,
+                cooldown_s=breaker_cooldown_s))
+            for rid, r in zip(ids, replicas)}
+        self._order = ids                 # fixed rotation order
+        self._rr = 0                      # analysis: shared-under(_lock)
+        self._seq = 0                     # analysis: shared-under(_lock)
+        self.routed = 0                   # analysis: shared-under(_lock)
+        self.retries = 0                  # analysis: shared-under(_lock)
+        self.ejections = 0                # analysis: shared-under(_lock)
+        self.readmissions = 0             # analysis: shared-under(_lock)
+        self.shed_no_replicas = 0         # analysis: shared-under(_lock)
+        self.shed_overloaded = 0          # analysis: shared-under(_lock)
+        # Completion timestamps (monotonic) of recently served requests —
+        # the live service-rate estimate Retry-After is derived from.
+        # analysis: shared-under(_lock)
+        self._served_t: List[float] = []
+        self._stop = threading.Event()
+        self._health_thread: Optional[threading.Thread] = None
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, images, timeout: Optional[float] = None):
+        """Route ``images`` to a healthy replica inside one deadline
+        budget; bounded jittered retries on replica failure; immediate
+        re-route (no budget charge) when a replica is draining mid-swap;
+        shed with a derived ``Retry-After`` when nothing can take it."""
+        deadline = time.monotonic() + (self.default_timeout_s
+                                       if timeout is None else
+                                       max(float(timeout), 0.0))
+        failures = 0
+        full: set = set()   # replicas that answered QueueFull this request
+        failed_on: set = set()  # replicas that FAILED this request already
+        last_err: Optional[BaseException] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"deadline budget exhausted after {failures} "
+                    f"failure(s); last error: {last_err!r}")
+            st, seq = self._pick(exclude=full | failed_on)
+            if st is None and failed_on:
+                # Every untried replica is out; retrying the one that
+                # already failed this request beats shedding it (a
+                # crashed replica has an empty queue and would otherwise
+                # keep winning least-loaded until its breaker trips).
+                st, seq = self._pick(exclude=full)
+            if st is None:
+                if full:
+                    # Healthy replicas exist but every one of them is at
+                    # admission capacity: shed NOW with the backlog-drain
+                    # estimate, not a timeout 30 s from now.
+                    with self._lock:
+                        self.shed_overloaded += 1
+                    raise RouterOverloaded(
+                        f"all {len(full)} healthy replica(s) at admission "
+                        "capacity; retry after backoff",
+                        self._overload_retry_after())
+                with self._lock:
+                    self.shed_no_replicas += 1
+                raise NoHealthyReplicas(
+                    "no healthy replicas (all ejected or circuit-open); "
+                    "retry after the next re-admission probe",
+                    self._readmit_retry_after())
+            try:
+                out = st.replica.submit(images, timeout=remaining)
+            except (ValueError, TypeError, RequestTooLarge):
+                raise       # the CLIENT's error: no retry, no breaker hit
+            except QueueFull:
+                # Backpressure, not failure: try the other replicas with
+                # no budget charge; all-full is handled above.
+                full.add(st.replica.replica_id)
+                continue
+            except Draining:
+                # The replica is mid-hot-swap or shutting down — its old
+                # batcher flushed this request un-served.  Not a fault of
+                # the replica: re-route at once (a tiny jittered pause
+                # keeps a swap transition from becoming a hot spin).
+                with self._lock:
+                    self.retries += 1
+                    pause = self._rng.uniform(0.0, 0.005)
+                with self.tracer.span("retry", overlap=True):
+                    time.sleep(min(pause, max(remaining, 0.0)))
+                continue
+            except TimeoutError as e:
+                # The budget died inside the replica; record the failure
+                # for the breaker but there is nothing left to retry with.
+                st.breaker.record_failure()
+                with self._lock:
+                    st.failed += 1
+                raise TimeoutError(
+                    f"replica {st.replica.replica_id} exceeded the "
+                    f"deadline budget: {e}") from e
+            except Exception as e:
+                # Replica-side failure (crash, wedged engine, transport):
+                # breaker-countable, retryable within the budget.
+                st.breaker.record_failure()
+                last_err = e
+                failures += 1
+                failed_on.add(st.replica.replica_id)
+                with self._lock:
+                    st.failed += 1
+                if failures > self.max_retries:
+                    raise
+                with self._lock:
+                    self.retries += 1
+                    # Jittered exponential backoff, never past deadline.
+                    pause = (self.backoff_s * (2 ** (failures - 1))
+                             * self._rng.uniform(0.5, 1.5))
+                with self.tracer.span("retry", step=seq, overlap=True):
+                    time.sleep(min(pause,
+                                   max(deadline - time.monotonic(), 0.0)))
+                continue
+            st.breaker.record_success()
+            with self._lock:
+                st.served += 1
+                self._served_t.append(time.monotonic())
+                if len(self._served_t) > 512:
+                    del self._served_t[:256]
+            return out
+
+    def _pick(self, exclude: set) -> Tuple[Optional[_ReplicaState],
+                                           Optional[int]]:
+        """Least-loaded healthy replica (round-robin tie-break), CLOSED
+        breakers first; a replica whose breaker is OPEN-past-cooldown or
+        HALF-OPEN is only picked when no CLOSED one exists, and claiming
+        its single probe slot happens HERE (``allow()``), so probing N
+        candidates never leaks N probes.  Recorded as a ``route`` span."""
+        with self.tracer.span("route", overlap=True):
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                self.routed += 1
+                rr = self._rr
+                self._rr += 1
+                live = [self._states[rid]
+                        for rid in (self._order[rr % len(self._order):]
+                                    + self._order[:rr % len(self._order)])
+                        if not self._states[rid].ejected
+                        and rid not in exclude]
+            closed = [st for st in live
+                      if st.breaker.snapshot()["state"] == "closed"]
+            for st in sorted(closed, key=lambda s: s.replica.queue_depth()):
+                if st.breaker.allow():
+                    return st, seq
+            for st in live:     # open/half-open: first claimable probe
+                if st.breaker.allow():
+                    return st, seq
+            return None, seq
+
+    # -- shed math ---------------------------------------------------------
+
+    def _overload_retry_after(self) -> float:
+        """Live backlog / measured service rate: how long until the
+        queues now standing have drained, clamped to [1, 60] s."""
+        depth = 0
+        with self._lock:
+            states = list(self._states.values())
+            now = time.monotonic()
+            recent = [t for t in self._served_t if now - t <= 5.0]
+        for st in states:
+            if not st.ejected:
+                try:
+                    depth += st.replica.queue_depth()
+                except Exception:
+                    pass
+        rate = len(recent) / 5.0 if recent else 0.0
+        if rate <= 0:
+            return 1.0
+        return min(max(depth / rate, 1.0), 60.0)
+
+    def _readmit_retry_after(self) -> float:
+        with self._lock:
+            etas = [st.readmit_at for st in self._states.values()
+                    if st.ejected]
+        if not etas:
+            return 1.0
+        return min(max(min(etas) - time.monotonic(), 1.0), 60.0)
+
+    # -- health prober -----------------------------------------------------
+
+    def start(self) -> "Router":
+        """Start the background health prober (idempotent)."""
+        if self._health_thread is None:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True,
+                name="router-health")
+            self._health_thread.start()
+        return self
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.health_tick()
+            except Exception as e:    # the prober must never die silently
+                print(f"WARNING: router health tick failed "
+                      f"({type(e).__name__}: {e}); next tick continues",
+                      file=sys.stderr)
+
+    def health_tick(self) -> None:
+        """One probe round over every replica — the health loop's body,
+        callable directly (tests, single-threaded embedders)."""
+        now = time.monotonic()
+        with self._lock:
+            states = [self._states[rid] for rid in self._order]
+        for st in states:
+            with self._lock:
+                if st.ejected and now < st.readmit_at:
+                    continue
+                ejected = st.ejected
+            ok = self._probe(st)
+            if ejected and ok:
+                with self.tracer.span("readmit"):
+                    with self._lock:
+                        st.ejected = False
+                        st.health_failures = 0
+                        st.readmit_backoff_s = 0.0
+                        self.readmissions += 1
+                st.breaker.record_success()   # give it requests again
+                _log(f"router: replica {st.replica.replica_id} healthy "
+                     "again; READMITTED to rotation")
+            elif ejected and not ok:
+                with self._lock:
+                    st.readmit_backoff_s = min(
+                        max(st.readmit_backoff_s * 2.0,
+                            self.readmit_base_s),
+                        self.readmit_max_s)
+                    st.readmit_at = time.monotonic() + st.readmit_backoff_s
+            elif not ejected and not ok:
+                with self._lock:
+                    st.health_failures += 1
+                    trip = st.health_failures >= self.eject_after
+                if trip:
+                    with self.tracer.span("eject"):
+                        with self._lock:
+                            st.ejected = True
+                            st.ejections += 1
+                            self.ejections += 1
+                            st.readmit_backoff_s = self.readmit_base_s
+                            st.readmit_at = (time.monotonic()
+                                             + st.readmit_backoff_s)
+                    _log(f"router: replica {st.replica.replica_id} failed "
+                         f"{self.eject_after} consecutive health probes; "
+                         "EJECTED from rotation (re-admission probes "
+                         "backing off exponentially)")
+            else:
+                with self._lock:
+                    st.health_failures = 0
+
+    @staticmethod
+    def _probe(st: _ReplicaState) -> bool:
+        try:
+            h = st.replica.health()
+        except Exception:
+            return False
+        return isinstance(h, dict) and h.get("status") == "ok"
+
+    # -- introspection / lifecycle ----------------------------------------
+
+    def replica_health(self) -> List[dict]:
+        """Best-effort health of every replica (dead ones reported, not
+        raised) — the fleet /healthz body."""
+        out = []
+        with self._lock:
+            states = [self._states[rid] for rid in self._order]
+        for st in states:
+            try:
+                h = dict(st.replica.health())
+            except Exception as e:
+                h = {"status": "dead", "replica_id": st.replica.replica_id,
+                     "error": f"{type(e).__name__}: {e}"}
+            with self._lock:
+                h["ejected"] = st.ejected
+            h["breaker"] = st.breaker.snapshot()["state"]
+            out.append(h)
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            base = {
+                "replicas": len(self._order),
+                "routed": self.routed,
+                "retries": self.retries,
+                "ejections": self.ejections,
+                "readmissions": self.readmissions,
+                "shed_no_replicas": self.shed_no_replicas,
+                "shed_overloaded": self.shed_overloaded,
+            }
+            per = [(st, st.ejected, st.served, st.failed, st.ejections)
+                   for st in (self._states[rid] for rid in self._order)]
+        base["per_replica"] = [{
+            "replica_id": st.replica.replica_id,
+            "ejected": ejected,
+            "served": served,
+            "failed": failed,
+            "ejections": ejections,
+            "breaker": st.breaker.snapshot(),
+            "queue_depth": _safe_depth(st.replica),
+        } for st, ejected, served, failed, ejections in per]
+        return base
+
+    def close(self) -> None:
+        """Stop the health prober (idempotent; replicas are owned and
+        closed by the fleet, not the router)."""
+        self._stop.set()
+        t = self._health_thread
+        if t is not None:
+            t.join(timeout=10.0)
+            self._health_thread = None
+
+
+def _safe_depth(replica) -> Optional[int]:
+    try:
+        return int(replica.queue_depth())
+    except Exception:
+        return None
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr)
+    sys.stderr.flush()

@@ -1,0 +1,716 @@
+"""Fault-tolerant serve fleet (ddp_tpu/serve/{router,fleet}.py) — ISSUE 11.
+
+Five contracts:
+- BREAKER: the per-replica circuit trips on consecutive failures, cools
+  down exponentially, and HALF-OPEN admits exactly one probe — even
+  under concurrent allow() calls.
+- ROUTING: retries stay inside one deadline budget (no retry storm),
+  client errors are never retried, Draining re-routes without a breaker
+  hit, QueueFull excludes the full replica, and when nothing can take
+  the request the router sheds NOW with a derived Retry-After.
+- HEALTH: consecutive probe failures eject a replica, re-admission
+  probes back off exponentially, and a healed replica re-enters
+  rotation.
+- HOT-SWAP: the (engine, batcher) pair rotates atomically — every
+  accepted request is served by the snapshot that accepted it, admission
+  never pauses, a torn publish is skipped with a named event, and the
+  next good publish still swaps.
+- CHAOS: replica kill + mid-load checkpoint hot-swap with real engines
+  produce ZERO failed client requests, and the eject/swap spans export
+  as schema-valid Perfetto trace events.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_tpu.models import get_model
+from ddp_tpu.optim import SGDState
+from ddp_tpu.parallel import make_mesh
+from ddp_tpu.resilience.lineage import CheckpointLineage, head_fingerprint
+from ddp_tpu.serve import (CircuitBreaker, Draining, DynamicBatcher,
+                           HTTPReplica, LocalReplica, NoHealthyReplicas,
+                           QueueFull, ReplicaCrashed, RequestTooLarge,
+                           Router, RouterOverloaded, ServeFleet,
+                           ServeHTTPServer)
+from ddp_tpu.train import save_checkpoint
+
+
+def _images(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, (n, 32, 32, 3)).astype(np.uint8)
+
+
+# -- circuit breaker -------------------------------------------------------
+
+def test_breaker_trips_after_consecutive_failures():
+    br = CircuitBreaker(trip_after=3, cooldown_s=60.0)
+    assert br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.snapshot()["state"] == "closed"  # streak not yet at 3
+    br.record_failure()
+    assert br.snapshot()["state"] == "open"
+    assert not br.allow()                      # cooldown still running
+    assert br.snapshot()["trips"] == 1
+
+
+def test_breaker_success_resets_the_streak():
+    br = CircuitBreaker(trip_after=2, cooldown_s=60.0)
+    br.record_failure()
+    br.record_success()
+    br.record_failure()                        # 1 again, not 2
+    assert br.snapshot()["state"] == "closed"
+    assert br.allow()
+
+
+def test_breaker_half_open_admits_exactly_one_probe():
+    br = CircuitBreaker(trip_after=1, cooldown_s=0.02)
+    br.record_failure()
+    assert not br.allow()
+    time.sleep(0.03)                           # cooldown expired
+    grants = []
+    lock = threading.Lock()
+
+    def racer():
+        ok = br.allow()
+        with lock:
+            grants.append(ok)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert grants.count(True) == 1             # the single half-open probe
+    assert br.snapshot()["state"] == "half-open"
+    br.record_success()
+    assert br.snapshot()["state"] == "closed"
+    assert br.allow() and br.allow()           # closed: unlimited again
+
+
+def test_breaker_reopen_doubles_cooldown_capped():
+    br = CircuitBreaker(trip_after=1, cooldown_s=1.0, cooldown_max_s=3.0)
+    br.record_failure()
+    assert br.snapshot()["cooldown_s"] == 2.0  # next cooldown, doubled
+    br._open_until = 0.0                       # force the cooldown over
+    assert br.allow()                          # the half-open probe
+    br.record_failure()                        # probe failed: re-open
+    assert br.snapshot()["state"] == "open"
+    assert br.snapshot()["cooldown_s"] == 3.0  # capped, not 4.0
+    br.record_success()
+    assert br.snapshot()["cooldown_s"] == 1.0  # success resets backoff
+
+
+# -- router (stub replicas) ------------------------------------------------
+
+class _StubReplica:
+    """Replica-protocol double with scriptable failure modes."""
+
+    def __init__(self, replica_id, depth=0):
+        self.replica_id = replica_id
+        self.mode = "ok"   # ok|crash|queue_full|draining|client_error
+        self.healthy = True
+        self.crashed = False   # the fault injector's latch (LocalReplica)
+        self.depth = depth
+        self.calls = 0
+        self.served = 0
+
+    def submit(self, images, timeout=None):
+        self.calls += 1
+        if self.crashed or self.mode == "crash":
+            raise ReplicaCrashed(f"{self.replica_id} is down")
+        if self.mode == "queue_full":
+            raise QueueFull(f"{self.replica_id} admission queue full")
+        if self.mode == "draining":
+            raise Draining(f"{self.replica_id} draining for swap")
+        if self.mode == "client_error":
+            raise ValueError("pixel values must be integers")
+        self.served += 1
+        return np.full((images.shape[0], 10),
+                       float(self.replica_id[-1]), np.float32)
+
+    def health(self):
+        if self.crashed or not self.healthy:
+            raise ReplicaCrashed(f"{self.replica_id} probe refused")
+        return {"status": "ok", "replica_id": self.replica_id,
+                "queue_depth": self.depth}
+
+    def queue_depth(self):
+        return self.depth
+
+    def stats(self):
+        return {"replica_id": self.replica_id, "served": self.served}
+
+
+def test_router_rejects_empty_and_duplicate_replica_sets():
+    with pytest.raises(ValueError, match="at least one"):
+        Router([])
+    with pytest.raises(ValueError, match="duplicate"):
+        Router([_StubReplica("r0"), _StubReplica("r0")])
+
+
+def test_retry_lands_on_another_replica_after_a_crash():
+    r0, r1 = _StubReplica("r0", depth=0), _StubReplica("r1", depth=1)
+    r0.mode = "crash"          # least-loaded: r0 is picked first
+    router = Router([r0, r1], max_retries=2, backoff_ms=1.0)
+    out = router.submit(_images(2), timeout=5)
+    assert float(out[0, 0]) == 1.0             # r1 answered
+    assert r1.served == 1
+    assert router.stats()["retries"] >= 1
+    assert r0.calls == 1       # failed_on keeps the retry OFF the victim
+    per = {p["replica_id"]: p for p in router.stats()["per_replica"]}
+    assert per["r0"]["failed"] == 1 and per["r0"]["breaker"]["failures"] == 1
+
+
+def test_client_errors_are_never_retried():
+    r0, r1 = _StubReplica("r0", depth=0), _StubReplica("r1", depth=1)
+    r0.mode = "client_error"
+    router = Router([r0, r1], max_retries=5)
+    with pytest.raises(ValueError, match="pixel values"):
+        router.submit(_images(2), timeout=5)
+    assert r0.calls == 1 and r1.calls == 0     # nobody retried it
+    per = {p["replica_id"]: p for p in router.stats()["per_replica"]}
+    assert per["r0"]["breaker"]["failures"] == 0   # not the replica's fault
+    assert router.stats()["retries"] == 0
+
+
+def test_draining_reroutes_without_a_breaker_hit():
+    r0, r1 = _StubReplica("r0"), _StubReplica("r1")
+    r0.mode = "draining"
+    router = Router([r0, r1], max_retries=0)   # re-route is NOT a retry
+    for _ in range(4):
+        out = router.submit(_images(1), timeout=5)
+        assert float(out[0, 0]) == 1.0
+    per = {p["replica_id"]: p for p in router.stats()["per_replica"]}
+    assert per["r0"]["breaker"]["state"] == "closed"
+    assert per["r0"]["breaker"]["failures"] == 0
+    assert per["r0"]["failed"] == 0
+
+
+def test_queue_full_excludes_the_full_replica_then_sheds_overloaded():
+    r0, r1 = _StubReplica("r0", depth=0), _StubReplica("r1", depth=1)
+    r0.mode = "queue_full"
+    router = Router([r0, r1])
+    out = router.submit(_images(1), timeout=5)     # r1 takes it
+    assert float(out[0, 0]) == 1.0
+    r1.mode = "queue_full"                         # now everyone is full
+    with pytest.raises(RouterOverloaded) as e:
+        router.submit(_images(1), timeout=5)
+    assert 1.0 <= e.value.retry_after_s <= 60.0
+    assert router.stats()["shed_overloaded"] == 1
+    assert isinstance(e.value, QueueFull)          # bench/http shed mapping
+
+
+def test_deadline_budget_bounds_retries_no_retry_storm():
+    r0 = _StubReplica("r0")
+    r0.mode = "crash"
+    router = Router([r0], max_retries=10_000, backoff_ms=5.0,
+                    breaker_trip_after=10_000)
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="deadline budget"):
+        router.submit(_images(1), timeout=0.3)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5               # the budget, not max_retries, ruled
+    assert r0.calls < 30               # exponential backoff: no hot spin
+
+
+def test_health_tick_ejects_backs_off_and_readmits():
+    r0, r1 = _StubReplica("r0"), _StubReplica("r1")
+    router = Router([r0, r1], eject_after=2, readmit_base_s=0.05,
+                    readmit_max_s=10.0)
+    r0.healthy = False
+    router.health_tick()               # failure 1: still in rotation
+    assert not router._states["r0"].ejected
+    router.health_tick()               # failure 2: ejected
+    st = router._states["r0"]
+    assert st.ejected and router.stats()["ejections"] == 1
+    assert st.readmit_backoff_s == 0.05
+    time.sleep(0.06)
+    router.health_tick()               # still down: backoff doubles
+    assert st.ejected and st.readmit_backoff_s == 0.1
+    time.sleep(0.12)
+    r0.healthy = True
+    router.health_tick()               # healed: back in rotation
+    assert not st.ejected
+    assert router.stats()["readmissions"] == 1
+    health = {h["replica_id"]: h for h in router.replica_health()}
+    assert health["r0"]["ejected"] is False
+
+
+def test_all_ejected_sheds_with_readmit_eta():
+    reps = [_StubReplica("r0"), _StubReplica("r1")]
+    for r in reps:
+        r.healthy = False
+    router = Router(reps, eject_after=1, readmit_base_s=5.0)
+    router.health_tick()               # eject_after=1: both gone at once
+    with pytest.raises(NoHealthyReplicas) as e:
+        router.submit(_images(1), timeout=5)
+    assert 1.0 <= e.value.retry_after_s <= 60.0
+    assert router.stats()["shed_no_replicas"] == 1
+    health = {h["replica_id"]: h for h in router.replica_health()}
+    assert health["r0"]["status"] == "dead" and health["r0"]["ejected"]
+
+
+def test_open_breaker_takes_replica_out_of_rotation():
+    r0, r1 = _StubReplica("r0", depth=0), _StubReplica("r1", depth=9)
+    router = Router([r0, r1])
+    for _ in range(3):                 # trip r0's breaker by hand
+        router._states["r0"].breaker.record_failure()
+    out = router.submit(_images(1), timeout=5)
+    assert float(out[0, 0]) == 1.0     # r1 despite its deeper queue
+    assert r0.calls == 0
+
+
+# -- HTTP front end in fleet mode ------------------------------------------
+
+def _serve(httpd):
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_fleet_http_all_ejected_is_503_with_retry_after():
+    reps = [_StubReplica("r0"), _StubReplica("r1")]
+    for r in reps:
+        r.healthy = False
+    router = Router(reps, eject_after=1, readmit_base_s=5.0)
+    router.health_tick()
+
+    class _Facade:                     # the ServeFleet front-door surface
+        def submit(self, images, timeout=None):
+            return router.submit(images, timeout=timeout)
+
+        def health(self):
+            return {"status": "unavailable",
+                    "replicas": router.replica_health()}
+
+        def stats(self):
+            return {"router": router.stats(), "replicas": [], "swaps": []}
+
+    httpd = ServeHTTPServer(("127.0.0.1", 0), fleet=_Facade())
+    base = _serve(httpd)
+    try:
+        req = urllib.request.Request(
+            base + "/predict",
+            data=json.dumps({"instances": _images(1).tolist()}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 503
+        assert 1 <= int(e.value.headers["Retry-After"]) <= 60
+        assert "no healthy replicas" in json.load(e.value)["error"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+        assert e.value.code == 503
+        assert json.load(e.value)["status"] == "unavailable"
+    finally:
+        httpd.close()
+
+
+# -- engine-shaped double (no XLA) -----------------------------------------
+
+class _Engine:
+    """Versioned engine double: every logit equals the engine version, so
+    a response mixing snapshots is detectable in one np.unique call."""
+    input_shape = (32, 32, 3)
+
+    def __init__(self, version=1.0, delay_s=0.0, step=7):
+        self.version = float(version)
+        self.buckets = (8, 32)
+        self.max_rows = 32
+        self.delay_s = delay_s
+        self._seq = 0
+        self.trace_count = len(self.buckets)
+        self.checkpoint_file = "stub.pt"
+        self.checkpoint_epoch = 0
+        self.checkpoint_step = step
+
+    def forward(self, images):
+        self._seq += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.full((images.shape[0], 10), self.version, np.float32)
+
+    def stats(self):
+        return {"buckets": list(self.buckets),
+                "compiled_executables": self.trace_count,
+                "checkpoint": {"file": self.checkpoint_file,
+                               "epoch": self.checkpoint_epoch,
+                               "step": self.checkpoint_step}}
+
+
+# -- LocalReplica hot swap -------------------------------------------------
+
+def test_local_replica_swap_is_consistent_under_concurrent_load():
+    """Every response under a mid-load swap comes from ONE snapshot (all
+    rows equal), nobody sees an error besides the re-routable Draining
+    hand-off, and once swap() returns every new request is v2."""
+    e1 = _Engine(version=1.0, delay_s=0.002, step=1)
+    rep = LocalReplica("r0", e1, DynamicBatcher(e1, max_wait_ms=2.0).start())
+    stop = threading.Event()
+    versions, errors = [], []
+    lock = threading.Lock()
+
+    def client(seed):
+        while not stop.is_set():
+            try:
+                out = rep.submit(_images(4, seed=seed), timeout=10)
+            except Draining:
+                continue       # a fleet's router re-routes this; fine
+            except Exception as e:   # anything else is a real failure
+                with lock:
+                    errors.append(e)
+                return
+            vals = np.unique(out)
+            with lock:
+                versions.append((len(vals), float(vals[0])))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    e2 = _Engine(version=2.0, delay_s=0.002, step=2)
+    assert rep.swap(e2, DynamicBatcher(e2, max_wait_ms=2.0).start()) is True
+    out = rep.submit(_images(4), timeout=10)   # post-swap: new pair only
+    assert float(np.unique(out)[0]) == 2.0
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    assert all(n == 1 for n, _ in versions)    # never a mixed-snapshot row
+    seen = {v for _, v in versions}
+    assert seen <= {1.0, 2.0} and seen == {1.0, 2.0}
+    assert rep.swaps == 1
+    assert rep.health()["checkpoint_step"] == 2
+    assert rep.close() is True
+
+
+# -- lineage fingerprint ---------------------------------------------------
+
+def _publish(path, params, stats, *, step, epoch, keep=3):
+    """One training-side checkpoint publish: preserve the old head,
+    atomically write the new one, commit it to the lineage manifest."""
+    opt = SGDState(jax.tree_util.tree_map(jnp.zeros_like, params))
+    lin = CheckpointLineage(path, keep=keep)
+    lin.preserve_head()
+    sha = save_checkpoint(path, params, stats, opt, step=step, epoch=epoch)
+    lin.commit(epoch=epoch, step=step, sha256=sha)
+    return sha
+
+
+@pytest.fixture(scope="module")
+def deepnn():
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    return model, params, stats
+
+
+@pytest.fixture(scope="module")
+def mesh1():
+    return make_mesh(1)
+
+
+def test_head_fingerprint_tracks_publishes(tmp_path, deepnn):
+    _, params, stats = deepnn
+    path = str(tmp_path / "ck.pt")
+    assert head_fingerprint(None) is None
+    assert head_fingerprint(path) is None          # nothing published yet
+    with open(path, "wb") as f:                    # manifest-less head
+        f.write(b"x" * 64)
+    fp_stat = head_fingerprint(path)
+    assert fp_stat[0] == "stat"
+    with open(path, "wb") as f:
+        f.write(b"y" * 128)
+    assert head_fingerprint(path) != fp_stat       # stat signature moved
+    sha = _publish(path, params, stats, step=3, epoch=1)
+    fp1 = head_fingerprint(path)
+    assert fp1 == ("manifest", 1, 3, sha)
+    assert head_fingerprint(path) == fp1           # stable between polls
+    _publish(path, params, stats, step=4, epoch=2)
+    assert head_fingerprint(path) != fp1           # new publish detected
+    assert head_fingerprint(str(tmp_path)) == head_fingerprint(path)
+
+
+# -- fault env parsing -----------------------------------------------------
+
+def test_install_serve_faults_parses_env_specs(monkeypatch):
+    from ddp_tpu.resilience.faults import FAULT_ENV, install_serve_faults
+
+    class _DummyFleet:
+        def __init__(self):
+            self.replicas = [_StubReplica("r0"), _StubReplica("r1")]
+            self.snapshot_path = "nowhere"
+
+        def _load_snapshot(self):
+            raise AssertionError("not reached")
+
+    fleet = _DummyFleet()
+    monkeypatch.delenv(FAULT_ENV, raising=False)
+    install_serve_faults(fleet)        # unset: a no-op
+    monkeypatch.setenv(
+        FAULT_ENV, "crash_replica@requests=2,replica=1;"
+                   "slow_forward@ms=1,replica=0")
+    install_serve_faults(fleet)
+    fleet.replicas[1].submit(_images(1))           # request 1: still fine
+    assert not fleet.replicas[1].crashed
+    with pytest.raises(ReplicaCrashed):
+        fleet.replicas[1].submit(_images(1))       # request 2: latched
+    assert fleet.replicas[1].crashed
+    with pytest.raises(ReplicaCrashed):
+        fleet.replicas[1].health()                 # probes fail too
+    fleet.replicas[0].submit(_images(1))           # slow but serving
+    assert fleet.replicas[0].served == 1
+    monkeypatch.setenv(FAULT_ENV, "sigterm@epoch=1")   # trainer vocabulary
+    with pytest.raises(ValueError, match="serve fault kind"):
+        install_serve_faults(_DummyFleet())
+
+
+# -- http close() idempotency + single-mode payload fields -----------------
+
+def test_http_server_requires_a_backend():
+    with pytest.raises(ValueError, match="needs either"):
+        ServeHTTPServer(("127.0.0.1", 0))
+
+
+def test_http_close_is_idempotent_without_serve_forever():
+    """close() on a listener whose serve_forever never ran must return
+    (stdlib shutdown() would block forever waiting for the loop) — the
+    signal-handler-before-startup ordering."""
+    eng = _Engine()
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    done = threading.Event()
+
+    def closer():
+        httpd.close()
+        httpd.close()      # second call: immediate no-op
+        done.set()
+
+    t = threading.Thread(target=closer, daemon=True)
+    t.start()
+    assert done.wait(timeout=5), "close() blocked without serve_forever"
+    batcher.drain(timeout=5)
+
+
+def test_http_close_is_idempotent_after_serve_forever():
+    eng = _Engine()
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    base = _serve(httpd)
+    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+        assert r.status == 200
+    httpd.close()
+    httpd.close()          # from-a-signal-handler double call
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(base + "/healthz", timeout=2)
+    batcher.drain(timeout=5)
+
+
+def test_single_mode_healthz_identity_fields_and_empty_swap_history():
+    eng = _Engine(step=7)
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher,
+                            replica_id="r3")
+    base = _serve(httpd)
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            h = json.load(r)
+        assert h["replica_id"] == "r3"
+        assert h["checkpoint_step"] == 7
+        assert h["uptime_s"] >= 0 and h["queue_depth"] == 0
+        assert h["buckets"] == [8, 32]
+        with urllib.request.urlopen(base + "/stats", timeout=10) as r:
+            s = json.load(r)
+        assert s["swaps"] == []    # single engine: no swap machinery
+    finally:
+        httpd.close()
+        batcher.drain(timeout=5)
+
+
+# -- HTTPReplica -----------------------------------------------------------
+
+def test_http_replica_speaks_the_replica_protocol():
+    eng = _Engine(version=5.0)
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    base = _serve(httpd)
+    rep = HTTPReplica("h0", base)
+    try:
+        out = rep.submit(_images(2))
+        assert out.shape == (2, 10) and float(out[0, 0]) == 5.0
+        h = rep.health()
+        assert h["status"] == "ok" and h["replica_id"] == "r0"
+        assert rep.queue_depth() == 0      # cached from the probe
+        assert "batcher" in rep.stats()
+        with pytest.raises(RequestTooLarge):
+            rep.submit(_images(33))        # 413 mapped back
+        with pytest.raises(ValueError):
+            rep.submit(np.zeros((1, 32, 32, 3), np.float32))  # 400
+        batcher.drain(timeout=5)
+        assert rep.health()["status"] == "draining"   # 503 body surfaced
+        with pytest.raises(Draining):
+            rep.submit(_images(1))         # 503-draining: re-routable
+    finally:
+        httpd.close()
+        batcher.drain(timeout=5)
+    with pytest.raises(ReplicaCrashed):    # listener gone: transport error
+        rep.submit(_images(1))
+    with pytest.raises(Exception):         # probe fails loudly too
+        rep.health()
+
+
+# -- ServeFleet (real engines) ---------------------------------------------
+
+def test_fleet_refuses_bad_construction(tmp_path, mesh1):
+    from ddp_tpu.train import CheckpointError
+    with pytest.raises(ValueError, match="n_replicas"):
+        ServeFleet(str(tmp_path / "missing.pt"), "deepnn", mesh=mesh1,
+                   n_replicas=0)
+    with pytest.raises(CheckpointError, match="no checkpoint"):
+        ServeFleet(str(tmp_path / "missing.pt"), "deepnn", mesh=mesh1,
+                   n_replicas=1, buckets=(8,))
+
+
+def test_fleet_serves_and_hot_swaps_zero_downtime(tmp_path, deepnn, mesh1):
+    _, params, stats = deepnn
+    ck = str(tmp_path / "ck.pt")
+    _publish(ck, params, stats, step=1, epoch=0)
+    fleet = ServeFleet(ck, "deepnn", mesh=mesh1, n_replicas=2,
+                       buckets=(8,), max_wait_ms=1.0)
+    try:
+        imgs = _images(4)
+        before = fleet.submit(imgs, timeout=30)
+        assert before.shape == (4, 10)
+        assert fleet.poll_once() is None       # nothing new published
+        h = fleet.health()
+        assert h["status"] == "ok" and h["healthy_replicas"] == 2
+        assert h["checkpoint_step"] == 1
+        p2 = jax.tree_util.tree_map(lambda p: p * 1.5, params)
+        _publish(ck, p2, stats, step=2, epoch=1)
+        assert fleet.poll_once() == "swap_commit"
+        after = fleet.submit(imgs, timeout=30)
+        assert not np.array_equal(after, before)   # new weights serving
+        h = fleet.health()
+        assert h["status"] == "ok" and h["checkpoint_step"] == 2
+        s = fleet.stats()
+        last = s["swaps"][-1]
+        assert last["event"] == "swap_commit" and last["from_step"] == 1
+        assert last["old_drained_clean"] is True
+        assert all(r["swaps"] == 1 for r in s["replicas"])
+    finally:
+        assert fleet.close() is True
+        fleet.close()      # idempotent
+
+
+def test_fleet_skips_torn_publish_with_named_event(tmp_path, deepnn,
+                                                   mesh1):
+    """A publish torn right before the watcher loads it is SKIPPED with a
+    named swap_skipped event, serving continues on the old snapshot, and
+    the NEXT good publish still swaps (the fingerprint was consumed, not
+    wedged)."""
+    from ddp_tpu.resilience.faults import torn_publish
+    _, params, stats = deepnn
+    ck = str(tmp_path / "ck.pt")
+    _publish(ck, params, stats, step=1, epoch=0)
+    fleet = ServeFleet(ck, "deepnn", mesh=mesh1, n_replicas=1,
+                       buckets=(8,), max_wait_ms=1.0)
+    try:
+        torn_publish(fleet)                    # tears the NEXT load, once
+        _publish(ck, params, stats, step=3, epoch=1)
+        assert fleet.poll_once() == "swap_skipped"
+        ev = fleet.stats()["swaps"][-1]
+        assert ev["event"] == "swap_skipped"
+        assert "torn" in ev["reason"] or "verifiable" in ev["reason"]
+        assert fleet.health()["checkpoint_step"] == 1   # old snapshot live
+        assert fleet.submit(_images(3), timeout=30).shape == (3, 10)
+        assert fleet.poll_once() is None       # bad publish NOT re-tried
+        _publish(ck, params, stats, step=4, epoch=2)
+        assert fleet.poll_once() == "swap_commit"
+        assert fleet.health()["checkpoint_step"] == 4
+    finally:
+        fleet.close()
+
+
+def test_fleet_chaos_drill_replica_kill_and_swap_under_load(tmp_path,
+                                                            deepnn,
+                                                            mesh1):
+    """THE acceptance drill: 2 replicas under concurrent client load, one
+    killed mid-run by fault injection, a new checkpoint hot-swapped in
+    mid-load — zero failed client requests, the victim ejected, and the
+    route/eject/swap spans export as a schema-valid Perfetto trace."""
+    from ddp_tpu.obs.export import (read_spill, to_trace_events,
+                                    validate_trace_events)
+    from ddp_tpu.obs.tracer import SpanTracer
+    from ddp_tpu.resilience.faults import crash_replica_at_request_n
+    _, params, stats = deepnn
+    ck = str(tmp_path / "ck.pt")
+    _publish(ck, params, stats, step=1, epoch=0)
+    spill = str(tmp_path / "fleet_spill.jsonl")
+    tracer = SpanTracer(spill_path=spill)
+    fleet = ServeFleet(
+        ck, "deepnn", mesh=mesh1, n_replicas=2, buckets=(8,),
+        max_wait_ms=1.0, tracer=tracer,
+        router_kwargs=dict(health_interval_s=0.05, eject_after=2,
+                           readmit_base_s=0.2, backoff_ms=5.0))
+    fleet.start(poll_s=0)          # prober on; the watcher driven by hand
+    crash_replica_at_request_n(fleet.replicas[0], 8)
+    stop = threading.Event()
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            try:
+                out = fleet.submit(_images(int(rng.integers(1, 5)),
+                                           seed=seed), timeout=10)
+                assert out.shape[1] == 10
+                with lock:
+                    counts["ok"] += 1
+            except QueueFull:      # RouterShed included — backpressure
+                with lock:
+                    counts["shed"] += 1
+                time.sleep(0.01)
+            except Exception:
+                with lock:
+                    counts["failed"] += 1
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.6)            # crash fires + victim gets ejected
+        p2 = jax.tree_util.tree_map(lambda p: p * 1.25, params)
+        _publish(ck, p2, stats, step=5, epoch=1)
+        assert fleet.poll_once() == "swap_commit"   # mid-load hot swap
+        time.sleep(0.6)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert counts["failed"] == 0, counts
+        assert counts["ok"] >= 20, counts
+        rs = fleet.router.stats()
+        assert rs["ejections"] >= 1         # the victim left rotation
+        per = {p["replica_id"]: p for p in rs["per_replica"]}
+        assert per["r1"]["served"] > 0      # the survivor carried the load
+        assert fleet.health()["checkpoint_step"] == 5
+        assert fleet.stats()["swaps"][-1]["event"] == "swap_commit"
+    finally:
+        stop.set()
+        fleet.close()
+        tracer.close()
+    spans = read_spill([spill])
+    phases = {s["phase"] for s in spans}
+    assert {"route", "eject", "swap_warm", "swap_commit"} <= phases
+    assert {"forward", "queue_wait"} <= phases      # engines traced too
+    n_events = validate_trace_events(to_trace_events(spans))
+    assert n_events > len(spans)
