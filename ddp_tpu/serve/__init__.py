@@ -52,12 +52,12 @@ from .engine import (RequestTooLarge, ServeEngine, ServeError,
 from .fleet import HTTPReplica, LocalReplica, ServeFleet
 from .http import ServeHTTPServer
 from .router import (CircuitBreaker, NoHealthyReplicas, ReplicaCrashed,
-                     Router, RouterOverloaded, RouterShed)
+                     Router, RouterDraining, RouterOverloaded, RouterShed)
 
 __all__ = [
     "CircuitBreaker", "Draining", "DynamicBatcher", "HTTPReplica",
     "LocalReplica", "NoHealthyReplicas", "QueueFull", "ReplicaCrashed",
-    "RequestTooLarge", "Router", "RouterOverloaded", "RouterShed",
-    "ServeEngine", "ServeError", "ServeFleet", "ServeHTTPServer",
-    "percentiles", "resolve_buckets",
+    "RequestTooLarge", "Router", "RouterDraining", "RouterOverloaded",
+    "RouterShed", "ServeEngine", "ServeError", "ServeFleet",
+    "ServeHTTPServer", "percentiles", "resolve_buckets",
 ]

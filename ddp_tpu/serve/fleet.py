@@ -150,8 +150,10 @@ class HTTPReplica:
     router treats remote and in-process replicas identically: 503 ->
     :class:`Draining`/:class:`QueueFull` (re-route, no breaker hit),
     400/413 -> the client's own error (no retry), transport failures
-    (refused/reset/DNS/transport timeout) -> :class:`ReplicaCrashed`
-    (retry elsewhere, breaker-counted).
+    (refused/reset/DNS) -> :class:`ReplicaCrashed` (retry elsewhere,
+    breaker-counted), and timeouts — transport or replica-side 504 —
+    -> :class:`TimeoutError`, the same no-retry deadline path a
+    :class:`LocalReplica` batcher timeout takes.
     """
 
     def __init__(self, replica_id: str, base_url: str, *,
@@ -172,12 +174,27 @@ class HTTPReplica:
             headers={"Content-Type": "application/json"})
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout if timeout else 30.0) as r:
+                    req, timeout=timeout if timeout is not None
+                    else 30.0) as r:
                 out = json.load(r)
         except urllib.error.HTTPError as e:
             raise self._map_http_error(e) from None
-        except (urllib.error.URLError, socket.timeout, OSError,
-                ConnectionError) as e:
+        except urllib.error.URLError as e:
+            # A connect timeout arrives wrapped as the URLError reason;
+            # the deadline budget died, so take the router's no-retry
+            # TimeoutError path exactly like a LocalReplica would.
+            if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                raise TimeoutError(
+                    f"replica {self.replica_id} transport timeout: "
+                    f"{e.reason}") from None
+            raise ReplicaCrashed(
+                f"replica {self.replica_id} transport failure: "
+                f"{type(e).__name__}: {e}") from None
+        except (socket.timeout, TimeoutError) as e:
+            raise TimeoutError(
+                f"replica {self.replica_id} transport timeout: "
+                f"{e}") from None
+        except (OSError, ConnectionError) as e:
             raise ReplicaCrashed(
                 f"replica {self.replica_id} transport failure: "
                 f"{type(e).__name__}: {e}") from None
@@ -197,7 +214,9 @@ class HTTPReplica:
             return (Draining(msg) if "drain" in msg.lower()
                     else QueueFull(msg))
         if e.code == 504:
-            return ReplicaCrashed(f"replica-side timeout: {msg}")
+            # The remote batcher timed out THIS request's budget — the
+            # LocalReplica equivalent raises TimeoutError (no retry).
+            return TimeoutError(f"replica-side timeout: {msg}")
         return ReplicaCrashed(msg)
 
     def health(self) -> dict:

@@ -34,12 +34,15 @@ incidents with three mechanisms, each bounded and observable:
 Graceful degradation: when nothing can take the request the router
 sheds it *immediately* with a machine-actionable hint instead of letting
 it time out — :class:`NoHealthyReplicas` (everything ejected/open, retry
-after the soonest re-admission probe) or :class:`RouterOverloaded`
+after the soonest re-admission probe), :class:`RouterOverloaded`
 (every healthy replica's admission queue full, retry after the live
-backlog drains at the measured service rate).  Both carry
-``retry_after_s`` and subclass :class:`~ddp_tpu.serve.batcher.QueueFull`
-so the HTTP layer's 503 + ``Retry-After`` mapping and bench.py's shed
-accounting apply unchanged.
+backlog drains at the measured service rate), or
+:class:`RouterDraining` (every candidate answered ``Draining`` twice —
+a fleet mid-shutdown, not mid-swap — shed now like the single-engine
+503, never spin to the deadline).  All carry ``retry_after_s`` and
+subclass :class:`~ddp_tpu.serve.batcher.QueueFull` so the HTTP layer's
+503 + ``Retry-After`` mapping and bench.py's shed accounting apply
+unchanged.
 
 Telemetry: ``route`` (replica selection, per routed attempt) and
 ``retry`` (the backoff wait) are ``overlap=True`` handler-thread spans;
@@ -83,6 +86,14 @@ class NoHealthyReplicas(RouterShed):
 class RouterOverloaded(RouterShed):
     """Every healthy replica's admission queue is full; ``retry_after_s``
     is the live backlog divided by the measured service rate."""
+
+
+class RouterDraining(RouterShed, Draining):
+    """Every candidate replica answered ``Draining`` repeatedly — the
+    fleet is shutting down, not mid-swap.  Subclasses both
+    :class:`RouterShed` (503 + ``Retry-After``, shed accounting) and
+    :class:`~ddp_tpu.serve.batcher.Draining` (single-engine parity for
+    callers that catch the drain specifically)."""
 
 
 class CircuitBreaker:
@@ -136,6 +147,19 @@ class CircuitBreaker:
             self.failures = 0
             self._probe_out = False
             self._cooldown_s = self._base_cooldown_s
+
+    def release_probe(self) -> None:
+        """Release the half-open probe slot WITHOUT recording an outcome.
+
+        The router calls this when an attempt exits through a path that
+        says nothing about replica health — QueueFull, Draining, or the
+        client's own bad request.  Without it a granted probe whose
+        attempt never reached the replica's forward would leave
+        ``_probe_out`` latched True and ``allow()`` False forever: the
+        replica would be silently removed from rotation with no breaker
+        trip and no ejection to recover from."""
+        with self._lock:
+            self._probe_out = False
 
     def record_failure(self) -> None:
         with self._lock:
@@ -230,6 +254,7 @@ class Router:
         self.readmissions = 0             # analysis: shared-under(_lock)
         self.shed_no_replicas = 0         # analysis: shared-under(_lock)
         self.shed_overloaded = 0          # analysis: shared-under(_lock)
+        self.shed_draining = 0            # analysis: shared-under(_lock)
         # Completion timestamps (monotonic) of recently served requests —
         # the live service-rate estimate Retry-After is derived from.
         # analysis: shared-under(_lock)
@@ -250,6 +275,8 @@ class Router:
         failures = 0
         full: set = set()   # replicas that answered QueueFull this request
         failed_on: set = set()  # replicas that FAILED this request already
+        drained: set = set()    # replicas that answered Draining TWICE
+        drain_hits: Dict[str, int] = {}
         last_err: Optional[BaseException] = None
         while True:
             remaining = deadline - time.monotonic()
@@ -257,13 +284,13 @@ class Router:
                 raise TimeoutError(
                     f"deadline budget exhausted after {failures} "
                     f"failure(s); last error: {last_err!r}")
-            st, seq = self._pick(exclude=full | failed_on)
+            st, seq = self._pick(exclude=full | failed_on | drained)
             if st is None and failed_on:
                 # Every untried replica is out; retrying the one that
                 # already failed this request beats shedding it (a
                 # crashed replica has an empty queue and would otherwise
                 # keep winning least-loaded until its breaker trips).
-                st, seq = self._pick(exclude=full)
+                st, seq = self._pick(exclude=full | drained)
             if st is None:
                 if full:
                     # Healthy replicas exist but every one of them is at
@@ -275,6 +302,18 @@ class Router:
                         f"all {len(full)} healthy replica(s) at admission "
                         "capacity; retry after backoff",
                         self._overload_retry_after())
+                if drained:
+                    # Every candidate answered Draining twice: the fleet
+                    # is shutting down (a mid-swap replica serves again
+                    # on its FIRST re-route).  Shed NOW like the
+                    # single-engine 503 instead of busy-spinning retry
+                    # spans until the deadline turns this into a 500.
+                    with self._lock:
+                        self.shed_draining += 1
+                    raise RouterDraining(
+                        f"all {len(drained)} candidate replica(s) "
+                        "draining (fleet shutting down); retry shortly",
+                        1.0)
                 with self._lock:
                     self.shed_no_replicas += 1
                 raise NoHealthyReplicas(
@@ -284,17 +323,30 @@ class Router:
             try:
                 out = st.replica.submit(images, timeout=remaining)
             except (ValueError, TypeError, RequestTooLarge):
-                raise       # the CLIENT's error: no retry, no breaker hit
+                # The CLIENT's error: no retry, no breaker hit — but a
+                # granted half-open probe slot must not stay latched.
+                st.breaker.release_probe()
+                raise
             except QueueFull:
                 # Backpressure, not failure: try the other replicas with
                 # no budget charge; all-full is handled above.
+                st.breaker.release_probe()
                 full.add(st.replica.replica_id)
                 continue
             except Draining:
                 # The replica is mid-hot-swap or shutting down — its old
                 # batcher flushed this request un-served.  Not a fault of
                 # the replica: re-route at once (a tiny jittered pause
-                # keeps a swap transition from becoming a hot spin).
+                # keeps a swap transition from becoming a hot spin).  A
+                # SECOND Draining from the same replica means it is
+                # retiring, not swapping (a swap re-admits on the new
+                # pair immediately): exclude it; all-excluded sheds
+                # RouterDraining above.
+                st.breaker.release_probe()
+                rid = st.replica.replica_id
+                drain_hits[rid] = drain_hits.get(rid, 0) + 1
+                if drain_hits[rid] >= 2:
+                    drained.add(rid)
                 with self._lock:
                     self.retries += 1
                     pause = self._rng.uniform(0.0, 0.005)
@@ -329,6 +381,10 @@ class Router:
                 with self.tracer.span("retry", step=seq, overlap=True):
                     time.sleep(min(pause,
                                    max(deadline - time.monotonic(), 0.0)))
+                # Queues drain during the backoff: re-admit replicas that
+                # were merely full so the post-backoff pick can prefer a
+                # momentarily-full replica over the one that just FAILED.
+                full.clear()
                 continue
             st.breaker.record_success()
             with self._lock:
@@ -504,6 +560,7 @@ class Router:
                 "readmissions": self.readmissions,
                 "shed_no_replicas": self.shed_no_replicas,
                 "shed_overloaded": self.shed_overloaded,
+                "shed_draining": self.shed_draining,
             }
             per = [(st, st.ejected, st.served, st.failed, st.ejections)
                    for st in (self._states[rid] for rid in self._order)]

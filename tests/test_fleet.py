@@ -37,8 +37,8 @@ from ddp_tpu.resilience.lineage import CheckpointLineage, head_fingerprint
 from ddp_tpu.serve import (CircuitBreaker, Draining, DynamicBatcher,
                            HTTPReplica, LocalReplica, NoHealthyReplicas,
                            QueueFull, ReplicaCrashed, RequestTooLarge,
-                           Router, RouterOverloaded, ServeFleet,
-                           ServeHTTPServer)
+                           Router, RouterDraining, RouterOverloaded,
+                           ServeFleet, ServeHTTPServer)
 from ddp_tpu.train import save_checkpoint
 
 
@@ -93,6 +93,20 @@ def test_breaker_half_open_admits_exactly_one_probe():
     br.record_success()
     assert br.snapshot()["state"] == "closed"
     assert br.allow() and br.allow()           # closed: unlimited again
+
+
+def test_breaker_release_probe_keeps_half_open_reclaimable():
+    """release_probe() frees the single half-open slot WITHOUT recording
+    an outcome — the attempt never reached the replica's forward, so the
+    breaker must neither close nor re-open, just re-grant."""
+    br = CircuitBreaker(trip_after=1, cooldown_s=0.01)
+    br.record_failure()
+    time.sleep(0.02)                           # cooldown expired
+    assert br.allow()                          # the probe, claimed
+    assert not br.allow()                      # slot taken
+    br.release_probe()
+    assert br.snapshot()["state"] == "half-open"   # no outcome recorded
+    assert br.allow()                          # slot re-grantable
 
 
 def test_breaker_reopen_doubles_cooldown_capped():
@@ -192,6 +206,69 @@ def test_draining_reroutes_without_a_breaker_hit():
     assert per["r0"]["breaker"]["state"] == "closed"
     assert per["r0"]["breaker"]["failures"] == 0
     assert per["r0"]["failed"] == 0
+
+
+def test_half_open_probe_not_leaked_by_no_outcome_exits():
+    """A granted half-open probe whose attempt exits through QueueFull,
+    Draining, or a client error must release the probe slot — otherwise
+    the replica is silently out of rotation FOREVER (no breaker trip,
+    nothing for the health prober to readmit)."""
+    for no_outcome_mode, shed in [("queue_full", RouterOverloaded),
+                                  ("draining", RouterDraining),
+                                  ("client_error", ValueError)]:
+        r0 = _StubReplica("r0")
+        router = Router([r0], breaker_trip_after=1,
+                        breaker_cooldown_s=0.01)
+        r0_breaker = router._states["r0"].breaker
+        r0_breaker.record_failure()            # trip OPEN
+        time.sleep(0.02)                       # cooldown over: next
+        r0.mode = no_outcome_mode              # allow() is the probe
+        with pytest.raises(shed):
+            router.submit(_images(1), timeout=5)
+        assert r0_breaker.snapshot()["state"] == "half-open"
+        r0.mode = "ok"                         # replica recovers
+        out = router.submit(_images(1), timeout=5)   # probe re-granted
+        assert float(out[0, 0]) == 0.0
+        assert r0_breaker.snapshot()["state"] == "closed"
+
+
+def test_all_draining_sheds_fast_instead_of_spinning():
+    """Every replica answering Draining twice (fleet shutdown, not a
+    swap hand-off) sheds a 503-mappable RouterDraining NOW — not a
+    30 s busy-spin of retry spans ending in TimeoutError/HTTP 500."""
+    r0, r1 = _StubReplica("r0"), _StubReplica("r1")
+    r0.mode = r1.mode = "draining"
+    router = Router([r0, r1])
+    t0 = time.monotonic()
+    with pytest.raises(RouterDraining) as e:
+        router.submit(_images(1), timeout=30)
+    assert time.monotonic() - t0 < 1.0         # shed, not deadline-spun
+    assert e.value.retry_after_s >= 1.0
+    assert isinstance(e.value, QueueFull)      # bench/http shed mapping
+    assert isinstance(e.value, Draining)       # single-engine 503 parity
+    assert router.stats()["shed_draining"] == 1
+    assert r0.calls <= 2 and r1.calls <= 2     # two Draining answers each
+
+
+def test_momentarily_full_replica_readmitted_after_backoff():
+    """The QueueFull exclusion is cleared after a failure backoff: the
+    post-backoff pick must prefer a replica that was merely full over
+    endlessly re-trying the one that already FAILED this request."""
+    class _FullOnce(_StubReplica):
+        def submit(self, images, timeout=None):
+            self.calls += 1
+            if self.calls == 1:
+                raise QueueFull(f"{self.replica_id} momentarily full")
+            self.served += 1
+            return np.full((images.shape[0], 10),
+                           float(self.replica_id[-1]), np.float32)
+
+    r0, r1 = _FullOnce("r0", depth=0), _StubReplica("r1", depth=1)
+    r1.mode = "crash"
+    router = Router([r0, r1], max_retries=2, backoff_ms=1.0)
+    out = router.submit(_images(1), timeout=5)
+    assert float(out[0, 0]) == 0.0             # r0 took it post-backoff
+    assert r0.calls == 2 and r1.calls == 1     # r1 not hammered
 
 
 def test_queue_full_excludes_the_full_replica_then_sheds_overloaded():
@@ -565,6 +642,25 @@ def test_http_replica_speaks_the_replica_protocol():
         rep.submit(_images(1))
     with pytest.raises(Exception):         # probe fails loudly too
         rep.health()
+
+
+def test_http_replica_transport_timeout_is_timeout_error():
+    """A transport timeout is the request's budget dying, not a crashed
+    replica: HTTPReplica must raise TimeoutError so the router takes the
+    same no-retry deadline path a LocalReplica batcher timeout takes
+    (ReplicaCrashed here would burn retries on other replicas with a
+    budget that is already gone)."""
+    eng = _Engine(delay_s=0.5)
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    base = _serve(httpd)
+    rep = HTTPReplica("h0", base)
+    try:
+        with pytest.raises(TimeoutError):
+            rep.submit(_images(1), timeout=0.05)
+    finally:
+        httpd.close()
+        batcher.drain(timeout=5)
 
 
 # -- ServeFleet (real engines) ---------------------------------------------
