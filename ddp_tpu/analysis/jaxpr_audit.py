@@ -103,7 +103,9 @@ def audit_collectives(name: str, kind: str, inv: Dict,
     must be reduced over ``data``), ``forward`` (a serve/logits program:
     collective-free off the data axis — and in this codebase entirely
     collective-free, the logits gather is an out_sharding, not a
-    collective), or ``eval`` (the counter-psum evaluation step).
+    collective), ``eval`` (the counter-psum evaluation step), or
+    ``audit`` (the drift-audit fingerprint program — only the generic
+    invariants apply: data-axis psums allowed, everything else banned).
     ``plan`` (a TPPlan) switches on the model-axis budget from
     ``expected_collectives`` — the printed plan table's numbers; without a
     plan, ANY model-axis traffic is a wrong-axis collective.  ``zero``

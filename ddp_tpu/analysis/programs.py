@@ -19,6 +19,10 @@ build), invariants derive from (kind, zero, plan) in
 - ``forward`` — the serve logits program: collective-free off (and, here,
   on) the data axis.
 - ``eval``    — the counter-psum evaluation step.
+- ``audit``   — the drift-audit fingerprint program (resilience/drift.py):
+  psum-over-data only, params NOT donated (they are the live train state),
+  payload budgeted tiny (2 x n_leaves x 4 bytes — the SDC audit must stay
+  cheap enough to run every K steps, BENCH_r10.json).
 """
 from __future__ import annotations
 
@@ -139,12 +143,20 @@ def _build_forward(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
                         (_sds(ctx.params), _sds(ctx.stats), images), plan)
 
 
+def _build_drift(ctx: _Ctx, name: str) -> BuiltProgram:
+    from ..resilience.drift import make_drift_audit
+    fn = make_drift_audit(ctx.mesh1d)
+    return BuiltProgram(name, "audit", False, fn, (_sds(ctx.params),), None)
+
+
 def _spec(name, kind, *, zero=False, tp=False, accum=False) -> ProgramSpec:
     if kind == "update":
         build = functools.partial(_build_step, accum=accum, zero=zero,
                                   tp=tp)
     elif kind == "eval":
         build = functools.partial(_build_eval, tp=tp)
+    elif kind == "audit":
+        build = _build_drift
     else:
         build = functools.partial(_build_forward, tp=tp)
     return ProgramSpec(name, kind, zero, tp, build)
@@ -164,6 +176,7 @@ REGISTRY: Tuple[ProgramSpec, ...] = (
     _spec("eval_step@tp", "eval", tp=True),
     _spec("serve_forward@dp8", "forward"),
     _spec("serve_forward@tp", "forward", tp=True),
+    _spec("drift_audit@dp8", "audit"),
 )
 
 

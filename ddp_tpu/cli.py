@@ -215,11 +215,51 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "overwrite-in-place (multigpu.py:111)")
     p.add_argument("--on_nan", default="abort",
                    choices=["abort", "skip", "restore"],
-                   help="Loss health policy, checked on the existing "
+                   help="Non-finite loss policy, checked on the existing "
                         "deferred-loss flush (zero extra D2H): abort = "
                         "fail fast (default); skip = log and continue; "
                         "restore = reload the last good checkpoint and "
-                        "re-seed the step RNG")
+                        "re-seed the step RNG.  Alias into the step "
+                        "health guard (resilience/guard.py), which also "
+                        "hosts the spike detector below")
+    p.add_argument("--guard_window", default=64, type=int, metavar="W",
+                   help="Rolling window (steps) for the guard's "
+                        "median/MAD loss-spike detector (default 64; "
+                        "only read when --guard_spike_factor > 0)")
+    p.add_argument("--guard_spike_factor", default=0.0, type=float,
+                   metavar="F",
+                   help="Flag a step whose loss exceeds median * F + "
+                        "3*MAD over the last --guard_window finite "
+                        "losses (checked on the same deferred flush as "
+                        "--on_nan — zero extra D2H).  0 = spike "
+                        "detection off (default)")
+    p.add_argument("--guard_action", default="rollback",
+                   choices=["abort", "skip", "lr_backoff", "rollback"],
+                   help="What a loss spike triggers: abort = fail fast; "
+                        "skip = log and continue; lr_backoff = halve the "
+                        "LR schedule going forward; rollback (default) = "
+                        "restore the last verified checkpoint, re-seed, "
+                        "and skip the poisoned batch window on replay "
+                        "(shares the --on_nan restore budget)")
+    p.add_argument("--drift_audit_every", default=0, type=int, metavar="K",
+                   help="Cross-replica SDC audit (resilience/drift.py): "
+                        "every K optimizer steps, fingerprint each "
+                        "replica's parameters bit-level (uint32 checksum "
+                        "per leaf, NOT a float sum) and compare across "
+                        "the data axis with one tiny psum pair (~2*L*4 "
+                        "bytes; priced as drift_audit@dp8 in "
+                        "BUDGETS.json).  Replicated params must agree "
+                        "bit-for-bit, so any mismatch is silent data "
+                        "corruption: a drift_detected event names the "
+                        "offending leaves and replicas.  Streaming 1-D "
+                        "data-parallel only.  0 = off (default)")
+    p.add_argument("--drift_action", default="abort",
+                   choices=["abort", "restore"],
+                   help="What a drift detection triggers: abort = fail "
+                        "fast with the event on disk (default); restore "
+                        "= reload the newest verifiable checkpoint "
+                        "(shares the guard's restore budget, so "
+                        "persistent corruption cannot restore-loop)")
     p.add_argument("--watchdog_secs", default=0.0, type=float, metavar="S",
                    help="Abort the run (non-blocking dist.abort + exit "
                         f"status 124) when no step/epoch progress happens "
@@ -681,10 +721,28 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         t.start()
         t.join(timeout=3.0)
 
+    # The stall context additionally names the guard's last decision and
+    # the last drift-audit step (round 12): a stall during a rollback or
+    # an audit is diagnosable from the dump alone.  The trainer is built
+    # below, after the watchdog — reach it through a cell.
+    trainer_ref: list = []
+
+    def _stall_context() -> str:
+        parts = []
+        if tracer.enabled:
+            parts.append(tracer.describe_last(lock_timeout=2.0))
+        if trainer_ref:
+            t = trainer_ref[0]
+            drift = getattr(t, "_drift", None)
+            parts.append(
+                f"guard: last decision {t._health.last_decision}; "
+                f"drift audit: "
+                + (f"last at step {drift.last_audit_step}"
+                   if drift is not None else "off"))
+        return "\n".join(p for p in parts if p)
+
     watchdog = (Watchdog(args.watchdog_secs,
-                         context=((lambda: tracer.describe_last(
-                             lock_timeout=2.0)) if tracer.enabled
-                             else None),
+                         context=_stall_context,
                          on_expire=(_flush_spill_bounded if tracer.enabled
                                     else None))
                 if args.watchdog_secs > 0 else None)
@@ -743,7 +801,16 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       prefetch_workers=args.prefetch_workers,
                       prefetch_stats=pstats, tracer=tracer, live=live,
                       tp_plan=tp_plan,
-                      ckpt_format=getattr(args, "ckpt_format", "gathered"))
+                      ckpt_format=getattr(args, "ckpt_format", "gathered"),
+                      drift_audit_every=getattr(args, "drift_audit_every",
+                                                0),
+                      drift_action=getattr(args, "drift_action", "abort"),
+                      guard_window=getattr(args, "guard_window", 64),
+                      guard_spike_factor=getattr(args,
+                                                 "guard_spike_factor", 0.0),
+                      guard_action=getattr(args, "guard_action",
+                                           "rollback"))
+    trainer_ref.append(trainer)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real

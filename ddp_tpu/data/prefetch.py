@@ -103,7 +103,7 @@ def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,
                        depth: int = 2, workers: int = 4,
                        stats: Optional[PrefetchStats] = None,
                        shard_fn=None, tracer=None,
-                       step0: int = 0) -> Iterator[dict]:
+                       step0: int = 0, start: int = 0) -> Iterator[dict]:
     """Yield device-resident, data-sharded batches ahead of consumption.
 
     ``depth`` is how many batches may be in flight beyond the workers'
@@ -116,18 +116,34 @@ def prefetch_to_device(batches: Iterable[Dict[str, np.ndarray]], mesh,
     ``shard_batch_stacked`` for its ``[A, B, ...]`` group stacks).
     ``tracer`` (default: the process tracer) receives host_augment/h2d/
     data_wait spans, step-numbered from ``step0``.
+
+    ``start`` fast-forwards the epoch to batch index ``start`` — the
+    mid-epoch resume path (resilience/preemption): batches ``[0, start)``
+    are never materialised for ``materialize(k)`` loaders (random access
+    jumps straight to ``start``) and are materialised-but-dropped for
+    plain iterators (no random access to skip with).  The yielded stream
+    is bit-identical to the tail of the unoffset stream because batch
+    content is a function of ``(seed, epoch, k)`` alone, never of which
+    batches were consumed before it.
     """
     shard = shard_batch if shard_fn is None else shard_fn
     tracer = tracer if tracer is not None else get_tracer()
+    start = max(int(start), 0)
     if depth <= 0:
+        if start and hasattr(batches, "materialize") \
+                and hasattr(batches, "__len__"):
+            loader = batches  # bind NOW: the genexpr must not see itself
+            batches = (loader.materialize(k)
+                       for k in range(start, len(loader)))
+            start = 0
         yield from _passthrough(iter(batches), mesh, stats, shard, tracer,
-                                step0)
+                                step0, start)
     elif hasattr(batches, "materialize") and hasattr(batches, "__len__"):
         yield from _pooled(batches, mesh, depth, max(workers, 1), stats,
-                           shard, tracer, step0)
+                           shard, tracer, step0, start)
     else:
         yield from _threaded(iter(batches), mesh, depth, stats, shard,
-                             tracer, step0)
+                             tracer, step0, start)
 
 
 def _timed(stats: Optional[PrefetchStats], field: str, fn, *args):
@@ -139,15 +155,27 @@ def _timed(stats: Optional[PrefetchStats], field: str, fn, *args):
     return out
 
 
+def _skip(batches: Iterator, start: int) -> None:
+    """Advance a plain iterator past its first ``start`` items — the
+    no-random-access fast-forward (materialise cost is paid, device_put
+    is not).  Exhaustion before ``start`` just leaves an empty stream."""
+    for _ in range(start):
+        try:
+            next(batches)
+        except StopIteration:
+            return
+
+
 def _passthrough(batches: Iterator[Dict[str, np.ndarray]], mesh,
                  stats: Optional[PrefetchStats], shard, tracer,
-                 step0: int) -> Iterator[dict]:
+                 step0: int, start: int = 0) -> Iterator[dict]:
     """The unpipelined reference shape: one batch materialised, shipped,
     then consumed, strictly in sequence (singlegpu.py:104-107's loop).
     Everything runs on the consumer thread, so the spans are serial
     (overlap=False) — exactly the attribution the depth-0 mode exists
     to expose.  A span whose body raises StopIteration is not recorded
     (tracer contract), so the exhaustion probe leaves no bogus span."""
+    _skip(batches, start)
     k = step0
     while True:
         try:
@@ -173,16 +201,19 @@ def _materialize_traced(tracer, stats, loader, k: int, step0: int):
 
 def _pooled(loader, mesh, depth: int, workers: int,
             stats: Optional[PrefetchStats], shard, tracer,
-            step0: int) -> Iterator[dict]:
+            step0: int, start: int = 0) -> Iterator[dict]:
     n = len(loader)
     pool = ThreadPoolExecutor(max_workers=workers,
                               thread_name_prefix="ddp_tpu_prefetch")
     futures: deque = deque()
     try:
+        # ``start`` is the mid-epoch resume offset: random access means
+        # the skipped prefix is simply never submitted.
         futures.extend(pool.submit(_materialize_traced, tracer, stats,
                                    loader, k, step0)
-                       for k in range(min(workers + depth, n)))
-        next_k = len(futures)
+                       for k in range(start,
+                                      min(start + workers + depth, n)))
+        next_k = start + len(futures)
         i = 0
         while futures:
             with tracer.span("data_wait", step=step0 + i):
@@ -206,7 +237,8 @@ def _pooled(loader, mesh, depth: int, workers: int,
 
 def _threaded(batches: Iterator[Dict[str, np.ndarray]], mesh, depth: int,
               stats: Optional[PrefetchStats], shard, tracer,
-              step0: int) -> Iterator[dict]:
+              step0: int, start: int = 0) -> Iterator[dict]:
+    _skip(batches, start)
     q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
 

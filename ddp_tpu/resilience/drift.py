@@ -1,0 +1,196 @@
+"""Cross-replica SDC drift audit — bit-level parameter fingerprints
+compared across the ``data`` axis with one tiny collective.
+
+Data-parallel training's core invariant is that every replica applies the
+IDENTICAL update to IDENTICAL parameters (the lockstep contract DDP relies
+on at multigpu.py:97, and the replicated-weight structure the framework's
+``P()`` param sharding encodes).  That makes silent data corruption —
+a flipped HBM bit, a miscompiled kernel on one chip, a torn DMA —
+*detectable by construction*: replicas must agree bit-for-bit, so any
+disagreement is a fault, full stop.  No tolerance window, no float
+epsilon.
+
+The audit folds each replica's parameter pytree into a per-leaf 32-bit
+fingerprint (a multiplicative hash over the raw bit patterns — NOT a
+float sum, which could cancel a corruption or differ benignly in
+reduction order) and compares against replica 0's fingerprints with two
+``psum``s over ``data``.  Payload per audit: ``2 * n_leaves * 4`` bytes
+per device pair — a few hundred bytes for the bundled models, priced and
+budgeted like every other collective (``analysis/costmodel.py``; the
+``drift_audit@dp8`` registry entry).  The full parameter gather it
+replaces would be the entire model.
+
+uint32 throughout: ``jnp.uint64`` needs the x64 flag the framework never
+enables, and modular uint32 arithmetic is exactly what a checksum wants.
+
+Divergence handling (``DriftAuditor``): a named ``drift_detected`` event
+with the offending leaf paths and per-replica mismatch counts, then the
+configured action — ``abort`` (:class:`DriftDetectedError`) or
+``restore`` (reload the newest verifiable snapshot through the trainer's
+existing :class:`~ddp_tpu.resilience.guard.RestoreFromLastGood` path,
+sharing the guard's restore budget so persistent corruption cannot
+restore-loop forever).
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+
+DRIFT_ACTIONS = ("abort", "restore")
+
+# Knuth's multiplicative constant — any odd constant with good bit mixing
+# works; the hash only needs "a single flipped input bit changes the sum
+# with overwhelming probability", not cryptographic strength.
+_HASH_MULT = 2654435761
+
+
+class DriftDetectedError(RuntimeError):
+    """Replicas disagree bit-for-bit and the action said stop."""
+
+
+def _leaf_fingerprint(x: jax.Array) -> jax.Array:
+    """uint32 checksum of one leaf's raw bit pattern.
+
+    32-bit dtypes are bitcast (bit-exact sensitivity: any flipped bit
+    changes the fingerprint); other widths are cast to float32 first —
+    still deterministic and replica-comparable, just quantized.  The
+    per-element position is mixed in so two swapped values don't cancel.
+    """
+    flat = x.ravel()
+    if flat.dtype == jnp.uint32 or flat.dtype == jnp.int32:
+        bits = flat.astype(jnp.uint32) if flat.dtype == jnp.int32 \
+            else flat
+    elif flat.dtype.itemsize == 4:
+        bits = lax.bitcast_convert_type(flat, jnp.uint32)
+    else:
+        bits = lax.bitcast_convert_type(flat.astype(jnp.float32),
+                                        jnp.uint32)
+    pos = lax.iota(jnp.uint32, bits.shape[0])
+    h = (bits ^ (pos * jnp.uint32(0x9E3779B9))) * jnp.uint32(_HASH_MULT)
+    h = h ^ (h >> 15)
+    return jnp.sum(h, dtype=jnp.uint32)
+
+
+def leaf_paths(params) -> List[str]:
+    """Dot-joined key paths of ``params``' leaves, in flatten order —
+    the names a ``drift_detected`` event reports."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    return [jax.tree_util.keystr(kp) for kp, _ in flat]
+
+
+def make_drift_audit(mesh):
+    """Build the jitted audit program: ``fn(params) -> (counts, fps)``.
+
+    ``counts``: ``[L]`` uint32, replicated — for each leaf, how many
+    replicas disagree with replica 0's fingerprint (0 everywhere ⇔ the
+    lockstep invariant holds).  ``fps``: ``[R, L]`` uint32 sharded over
+    ``data`` — the per-replica fingerprint matrix, for naming WHICH
+    replica diverged in the event.  Params are NOT donated (the audit
+    must never invalidate the live training state) and the only
+    collectives are two ``psum``s over ``data`` — the shape the jaxpr
+    auditor's generic invariants (axis whitelist, no gathers) verify for
+    the registered ``drift_audit`` program.
+    """
+    def body(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        fps = jnp.stack([_leaf_fingerprint(x) for x in leaves])  # [L]
+        rid = lax.axis_index(DATA_AXIS)
+        # Replica 0's row, broadcast to everyone: mask-and-sum is one
+        # psum (no pbroadcast/ppermute — both are banned by the audit).
+        fp0 = lax.psum(jnp.where(rid == 0, fps, jnp.zeros_like(fps)),
+                       DATA_AXIS)
+        mism = (fps != fp0).astype(jnp.uint32)
+        counts = lax.psum(mism, DATA_AXIS)
+        return counts, fps[None, :]
+
+    # check_vma=False: the params are replicated, so the VMA tracker
+    # would rewrite the mask-and-psum into pbroadcast+psum2 (primitives
+    # the auditor bans).  The collectives here are explicit and total —
+    # the same unchecked regime train/zero.py and the TP wiring use.
+    mapped = jax.shard_map(body, mesh=mesh, in_specs=(P(),),
+                           out_specs=(P(), P(DATA_AXIS, None)),
+                           check_vma=False)
+    return jax.jit(mapped)
+
+
+class DriftAuditor:
+    """Every-K-steps audit driver for the trainer's streaming loop.
+
+    Synchronous by design: an audit step host-reads the ``[L]`` counts
+    vector (a few hundred bytes) and decides before the next dispatch —
+    corruption must not get K more steps of spreading through checkpoint
+    writes while the verdict floats in the async stream.  The ms this
+    costs every K steps is what ``bench.py --guard_overhead`` prices
+    (<1% ms/step at K=50 on the bench box, BENCH_r10.json).
+    """
+
+    def __init__(self, mesh, params_like, *, every: int,
+                 action: str = "abort"):
+        if action not in DRIFT_ACTIONS:
+            raise ValueError(
+                f"drift_action must be one of {DRIFT_ACTIONS}, got "
+                f"{action!r}")
+        self.every = int(every)
+        self.action = action
+        self.paths = leaf_paths(params_like)
+        self._fn = make_drift_audit(mesh)
+        self.last_audit_step: int = -1  # watchdog stall-context surface
+        self.detections = 0
+
+    def due(self, step: int) -> bool:
+        return self.every > 0 and step > 0 and step % self.every == 0
+
+    def audit(self, params, step: int, *, metrics=None, tracer=None,
+              guard=None) -> None:
+        """Run one audit at global ``step``; raise per the action on
+        divergence.  ``guard`` (the trainer's StepHealthGuard) supplies
+        the shared restore budget for ``action='restore'``."""
+        self.last_audit_step = int(step)
+        counts, fps = self._fn(params)
+        counts = np.asarray(jax.device_get(counts))
+        if not counts.any():
+            return
+        self.detections += 1
+        bad = np.flatnonzero(counts)
+        bad_paths = [self.paths[i] for i in bad[:16]]
+        fps_host = np.asarray(jax.device_get(fps))  # [R, L]
+        bad_replicas = sorted({
+            int(r) for i in bad
+            for r in np.flatnonzero(fps_host[:, i] != fps_host[0, i])})
+        msg = (f"cross-replica parameter drift at global step {step}: "
+               f"{len(bad)}/{counts.size} leaves diverge "
+               f"(e.g. {bad_paths[:4]}), replicas {bad_replicas[:8]} "
+               "disagree with replica 0 — silent data corruption on at "
+               "least one replica")
+        print(f"WARNING: {msg}", file=sys.stderr)
+        sys.stderr.flush()
+        if metrics is not None:
+            metrics.log_event(
+                "drift_detected", step=int(step), action=self.action,
+                leaves=bad_paths, replicas=bad_replicas[:32],
+                n_leaves_diverged=int(len(bad)))
+            metrics.fsync()  # the verdict must survive an abort
+        if self.action == "restore":
+            from .guard import RestoreFromLastGood
+            if guard is not None:
+                if guard.restores >= guard.max_restores:
+                    raise DriftDetectedError(
+                        f"{msg}; restore budget exhausted "
+                        f"({guard.restores}/{guard.max_restores})")
+                guard.restores += 1
+                guard.last_decision = f"drift_restore@step={int(step)}"
+            print("WARNING: --drift_action restore: reloading the last "
+                  "verified checkpoint", file=sys.stderr)
+            sys.stderr.flush()
+            raise RestoreFromLastGood(msg)
+        raise DriftDetectedError(
+            f"{msg}; --drift_action abort (pass --drift_action restore "
+            "to roll back to the last verified checkpoint instead)")

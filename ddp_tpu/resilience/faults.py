@@ -12,7 +12,15 @@ Faults:
   ``poison_loss``       replace the recorded loss at global step k with
                         NaN, once — drives the ``--on_nan`` policies
   ``sigterm_at_epoch``  deliver SIGTERM to this process at the end of
-                        epoch k — the preemption drill
+                        epoch k — the epoch-boundary preemption drill
+  ``sigterm_at_step``   deliver SIGTERM right before global step k — the
+                        mid-epoch preemption drill (data_state resume)
+  ``flip_param_bit``    flip one bit of a parameter on ONE replica before
+                        step k — the SDC the drift audit exists for
+  ``poison_batch``      scale the batch at step k by 1e4 — the loss-spike
+                        anomaly the rolling guard bounds
+  ``torn_data_state``   tear a checkpoint's data_state record — resume
+                        must degrade to the epoch boundary, warned once
   ``stall_at_epoch``    put one rank to sleep at the end of epoch k — the
                         hung-peer scenario the watchdog bounds
 
@@ -31,7 +39,8 @@ CI fleet smoke):
 
 Env surface for subprocess drills (``DDP_TPU_FAULT``): semicolon-separated
 specs ``kind@key=val,key=val`` — e.g.
-``sigterm@epoch=1``, ``poison@step=5``,
+``sigterm@epoch=1``, ``sigterm@step=12``, ``poison@step=5``,
+``flip_param_bit@step=6,replica=1``, ``poison_batch@step=9,scale=1e4``,
 ``stall@epoch=0,rank=1,secs=600``.  Serve processes
 (``python -m ddp_tpu.serve --fleet N``) parse the same variable through
 :func:`install_serve_faults` with the serve vocabulary:
@@ -87,11 +96,147 @@ def poison_loss(trainer, step: int, value: float = float("nan")) -> None:
 def _after_epoch(trainer, fn) -> None:
     orig = trainer._run_epoch
 
-    def wrapped(epoch):
-        orig(epoch)
+    def wrapped(epoch, *a, **kw):
+        orig(epoch, *a, **kw)
         fn(epoch)
 
     trainer._run_epoch = wrapped
+
+
+def _before_step(trainer, fn) -> None:
+    """Wrap ``trainer.train_step`` so ``fn(global_step)`` runs before each
+    dispatch — the step-granular injection point (the counter is the
+    host-side global step, resume-aware via ``trainer._host_step``)."""
+    orig = trainer.train_step
+    count = [None]
+
+    def wrapped(state, batch, rng):
+        if count[0] is None:
+            count[0] = int(trainer._host_step)
+        fn(count[0])
+        out = orig(state, batch, rng)
+        count[0] += 1
+        return out
+
+    trainer.train_step = wrapped
+
+
+def sigterm_at_step(trainer, step: int) -> None:
+    """Deliver SIGTERM to this process right before global step ``step``
+    dispatches — a preemption notice landing mid-epoch; the step-boundary
+    guard must take a mid-epoch emergency checkpoint whose ``data_state``
+    resumes bit-for-bit."""
+    fired = [False]
+
+    def fire(s):
+        if not fired[0] and s >= step:
+            fired[0] = True
+            print(f"[fault] delivering SIGTERM before step {s}",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    _before_step(trainer, fire)
+
+
+def flip_param_bit(trainer, step: int, replica: int = 1,
+                   bit: int = 28) -> None:
+    """Flip one bit of the first parameter leaf on ONE replica's copy,
+    right before global step ``step`` dispatches — the silent-data-
+    corruption model (an HBM upset on a single chip).  The corruption is
+    real divergence: replicas apply identical updates to now-different
+    values, so it persists until the drift audit's bit-level fingerprint
+    compare names the leaf.
+
+    The default bit 28 is a float32 EXPONENT bit: even on a 0.0 leaf the
+    flip yields a small *normal* number (2^-95), so the divergence
+    survives arithmetic.  A low mantissa bit on a zero leaf would make a
+    denormal that the first update's flush-to-zero multiply erases —
+    self-healing corruption the drill must not model."""
+    orig = trainer.train_step
+    count = [None]
+    fired = [False]
+
+    # Corrupts the state ARGUMENT of the wrapped dispatch, not
+    # trainer.state: the loop binds the argument before the wrapper runs,
+    # so a trainer.state assignment here would be overwritten by this very
+    # dispatch's output and the corruption would never enter the run.
+    def wrapped(state, batch, rng):
+        if count[0] is None:
+            count[0] = int(trainer._host_step)
+        if not fired[0] and count[0] >= step:
+            fired[0] = True
+            leaves, treedef = jax.tree_util.tree_flatten(state.params)
+            from .drift import leaf_paths
+            path = leaf_paths(state.params)[0]
+            x = leaves[0]
+            clean = np.asarray(jax.device_get(x))
+            corrupt = clean.copy()
+            if corrupt.dtype.itemsize == 4:
+                corrupt.view(np.uint32).reshape(-1)[0] ^= \
+                    np.uint32(1 << (bit % 32))
+            else:
+                corrupt.view(np.uint8).reshape(-1)[0] ^= \
+                    np.uint8(1 << (bit % 8))
+            devs = list(trainer.mesh.devices.flat)
+            r = replica % len(devs)
+            bufs = [jax.device_put(corrupt if i == r else clean, d)
+                    for i, d in enumerate(devs)]
+            leaves[0] = jax.make_array_from_single_device_arrays(
+                x.shape, x.sharding, bufs)
+            state = state._replace(
+                params=jax.tree_util.tree_unflatten(treedef, leaves))
+            print(f"[fault] flipped bit {bit} of param leaf {path!r} on "
+                  f"replica {r} before step {count[0]}", file=sys.stderr)
+            sys.stderr.flush()
+        out = orig(state, batch, rng)
+        count[0] += 1
+        return out
+
+    trainer.train_step = wrapped
+
+
+def poison_batch(trainer, step: int, scale: float = 1e4) -> None:
+    """Scale the batch dispatched at global step ``step`` by ``scale``,
+    once — a corrupted input shard.  The float-scaled images bypass the
+    uint8/255 normalisation, so the step's loss spikes by orders of
+    magnitude: the rolling median/MAD guard's target."""
+    orig = trainer.train_step
+    count = [None]
+    fired = [False]
+
+    def wrapped(state, batch, rng):
+        if count[0] is None:
+            count[0] = int(trainer._host_step)
+        if not fired[0] and count[0] >= step:
+            fired[0] = True
+            batch = dict(batch)
+            batch["image"] = (batch["image"].astype(np.float32)
+                              * np.float32(scale))
+            print(f"[fault] poisoned batch at step {count[0]} "
+                  f"(x{scale:g})", file=sys.stderr)
+            sys.stderr.flush()
+        out = orig(state, batch, rng)
+        count[0] += 1
+        return out
+
+    trainer.train_step = wrapped
+
+
+def torn_data_state(path: str) -> None:
+    """Replace a gathered checkpoint's ``data_state`` record with torn
+    bytes (the file is rewritten, so the lineage manifest's sha no longer
+    matches — the warn-but-attempt restore path).  The loader must treat
+    the unparseable record as absent: epoch-boundary resume with a
+    warning, never an error."""
+    from ..train.checkpoint import write_npz_hashed
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files}
+    flat["meta/data_state_json"] = np.frombuffer(b'{"torn', np.uint8)
+    write_npz_hashed(path, flat)
+    print(f"[fault] tore the data_state record of {path!r}",
+          file=sys.stderr)
+    sys.stderr.flush()
 
 
 def sigterm_at_epoch(trainer, epoch: int) -> None:
@@ -228,7 +373,18 @@ def install_env_faults(trainer) -> None:
         kind, _, argstr = part.partition("@")
         kv = dict(a.split("=", 1) for a in argstr.split(",") if a)
         if kind == "sigterm":
-            sigterm_at_epoch(trainer, int(kv["epoch"]))
+            # epoch= (the original boundary drill) or step= (mid-epoch).
+            if "step" in kv:
+                sigterm_at_step(trainer, int(kv["step"]))
+            else:
+                sigterm_at_epoch(trainer, int(kv["epoch"]))
+        elif kind == "flip_param_bit":
+            flip_param_bit(trainer, int(kv["step"]),
+                           replica=int(kv.get("replica", "1")),
+                           bit=int(kv.get("bit", "28")))
+        elif kind == "poison_batch":
+            poison_batch(trainer, int(kv["step"]),
+                         scale=float(kv.get("scale", "1e4")))
         elif kind == "poison":
             poison_loss(trainer, int(kv["step"]),
                         float(kv.get("value", "nan")))

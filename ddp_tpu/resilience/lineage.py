@@ -134,7 +134,8 @@ class CheckpointLineage:
             return None
 
     def commit(self, *, epoch: int, step: int, sha256: str,
-               shards: Optional[List[str]] = None) -> None:
+               shards: Optional[List[str]] = None,
+               data_state: Optional[Dict[str, Any]] = None) -> None:
         """Record the just-written head and trim retention to ``keep``
         states (the head plus ``keep - 1`` rotated snapshots).
 
@@ -174,6 +175,12 @@ class CheckpointLineage:
                                 "size": os.path.getsize(self.path)}
         if shards:
             head["shards"] = [os.path.basename(s) for s in shards]
+        if data_state is not None:
+            # Mirrored from the checkpoint's own meta/data_state_json so
+            # operators can read the resume position (epoch, iterator
+            # offset, seed, rng folds) from the 1 KB manifest without
+            # opening the npz.  The checkpoint file stays authoritative.
+            head["data_state"] = data_state
         # ...minus the ones still referenced AFTER it = the set to trim.
         new_shards = set(_entry_shards(head))
         for e in retained:

@@ -1,15 +1,17 @@
 """Preemption handling: SIGTERM/SIGINT -> one coordinated emergency
-checkpoint at the next epoch boundary, then a clean distinct-status exit.
+checkpoint at the next *step* boundary, then a clean distinct-status exit.
 
-Why the *epoch* boundary: the checkpoint format and resume semantics are
-epoch-granular (``--resume`` restarts at ``saved_epoch + 1``; the sampler
-reshuffles deterministically from ``seed + epoch`` and the step RNG is a
-pure function of the restored step counter), so an epoch-boundary emergency
-checkpoint resumes onto the *identical* trajectory an uninterrupted run
-takes — the property the preemption drill in tests/test_resilience.py pins.
-A mid-epoch snapshot would either lose the partial epoch's updates or
-double-train its batches on resume.  On the ``--resident`` path the whole
-epoch is one dispatch anyway, so the epoch boundary IS the step boundary.
+Why the step boundary is safe (round 12; it used to be the epoch
+boundary): the checkpoint payload now carries a ``data_state`` record —
+epoch, iterator offset, sampler seed, RNG fold count — and the prefetch
+engine fast-forwards to an arbitrary batch index, so a mid-epoch snapshot
+resumes onto the *identical* trajectory an uninterrupted run takes (the
+bit-for-bit property the mid-epoch drill in tests/test_resilience.py
+pins).  Batch content is a pure function of ``(seed, epoch, k)`` and the
+step RNG a pure function of the restored step counter, so neither loses
+the partial epoch's updates nor double-trains its batches.  On the
+``--resident`` path the whole epoch is one dispatch, so there the epoch
+boundary IS the step boundary and the stop decision stays per-epoch.
 
 Multi-host coordination: the local signal flag is OR-reduced across
 processes with a tiny jitted collective over the training mesh (the same
@@ -101,7 +103,7 @@ class PreemptionGuard:
     def _handler(self, signum, frame) -> None:
         self._noticed.set()
         print(f"preemption notice ({signal.Signals(signum).name}): will "
-              "take an emergency checkpoint at the next epoch boundary and "
+              "take an emergency checkpoint at the next step boundary and "
               "exit with status "
               f"{EMERGENCY_CHECKPOINT_EXIT_STATUS}; signal again to die "
               "immediately", file=sys.stderr)
@@ -119,7 +121,8 @@ class PreemptionGuard:
         return self._noticed.is_set()
 
     def should_stop(self, epoch: int, mesh) -> bool:
-        """Coordinated stop decision at the ``epoch`` boundary.
+        """Coordinated stop decision at the ``epoch`` boundary (the
+        ``--resident`` path, where the epoch IS the dispatch unit).
 
         Multi-host this is a COLLECTIVE — every process must call it at
         every epoch boundary, in the same order relative to the trainer's
@@ -129,6 +132,25 @@ class PreemptionGuard:
         pattern the divergence lint (``analysis/divergence.py``)
         sanctions: decide collectively, then branch.
         """
+        return self._should_stop_at(int(epoch), mesh)
+
+    def should_stop_step(self, step: int, mesh) -> bool:
+        """Coordinated stop decision at a global *step* boundary — the
+        streaming loop's per-step check.  Same collective discipline as
+        :meth:`should_stop`, with the global optimizer step as the one
+        sync-id space (monotonic across epochs, identical on every
+        process), so a notice delivered to any host stops every host at
+        the same step.  Single-process (every test topology and the
+        virtual-replica CPU meshes) this is a host-local Event check
+        plus one non-blocking manager poll — no device work on the
+        common no-signal step.  Multi-host it is a per-step collective,
+        unconditionally: the OR-reduce must run on every process or none
+        (divergence-lint discipline — a host-local branch around a
+        collective is the deadlock it lints against).
+        """
+        return self._should_stop_at(int(step), mesh)
+
+    def _should_stop_at(self, sync_id: int, mesh) -> bool:
         from ..parallel import dist
         local = self._noticed.is_set()
         mgr = dist.preemption_sync_manager()
@@ -137,7 +159,7 @@ class PreemptionGuard:
                 # Non-blocking; returns True on every process at the same
                 # (coordinated) counter once any task got a notice through
                 # the runtime's own channel.
-                local = bool(mgr.reached_sync_point(int(epoch))) or local
+                local = bool(mgr.reached_sync_point(sync_id)) or local
             except Exception:
                 pass  # manager torn down mid-run: the flag path stands
         if jax.process_count() == 1:

@@ -18,9 +18,10 @@ reference's ``backbone.conv0.weight``-style naming from its ``add()`` helper
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import tempfile
-from typing import Any, Dict, NamedTuple
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import numpy as np
@@ -51,6 +52,34 @@ class Checkpoint(NamedTuple):
     opt_state: SGDState
     step: int
     epoch: int
+    # Mid-epoch resume record (ISSUE 12): {"version", "epoch", "offset",
+    # "seed", "rng_folds"} — the POSITION TO RESUME FROM ("epoch" is the
+    # epoch to run next, "offset" the number of optimizer batches of it
+    # already consumed).  None on pre-round-14 files: epoch-boundary
+    # resume semantics (never an error).
+    data_state: Optional[Dict[str, Any]] = None
+
+
+def encode_data_state(data_state: Optional[Dict[str, Any]]):
+    """The npz-storable form of a data_state dict (a uint8 JSON blob —
+    npz members must be arrays), or None when there is nothing to record.
+    Shared by the gathered (v1) body and the sharded (v2) index."""
+    if data_state is None:
+        return None
+    return np.frombuffer(json.dumps(data_state).encode("utf-8"), np.uint8)
+
+
+def decode_data_state(blob) -> Optional[Dict[str, Any]]:
+    """Inverse of :func:`encode_data_state`; tolerant by contract — a
+    missing or unparseable record degrades to None (epoch-boundary
+    resume), never an error (MIGRATING.md: old checkpoints resume)."""
+    if blob is None:
+        return None
+    try:
+        ds = json.loads(np.asarray(blob, np.uint8).tobytes().decode("utf-8"))
+        return ds if isinstance(ds, dict) else None
+    except Exception:
+        return None
 
 
 # Nesting separator: "/" — model keys themselves may contain dots
@@ -128,7 +157,8 @@ class Sha256Writer:
 
 
 def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
-                    step: int, epoch: int, tracer=None) -> str:
+                    step: int, epoch: int, tracer=None,
+                    data_state: Optional[Dict[str, Any]] = None) -> str:
     """Atomic overwrite-in-place write (the reference overwrites too,
     multigpu.py:111 — atomically here so a preempted host never leaves a
     torn file for the other hosts to restore).  Returns the file's SHA-256
@@ -145,12 +175,13 @@ def save_checkpoint(path: str, params, batch_stats, opt_state: SGDState,
     tracer = tracer if tracer is not None else get_tracer()
     with tracer.span("ckpt_write", step=int(step), overlap=True):
         return _save_checkpoint_body(path, params, batch_stats, opt_state,
-                                     step, epoch)
+                                     step, epoch, data_state=data_state)
 
 
 def _save_checkpoint_body(path: str, params, batch_stats,
                           opt_state: SGDState, step: int,
-                          epoch: int) -> str:
+                          epoch: int,
+                          data_state: Optional[Dict[str, Any]] = None) -> str:
     flat: Dict[str, np.ndarray] = {}
     for section, tree in zip(_SECTIONS,
                              (params, batch_stats, opt_state.momentum_buf)):
@@ -165,6 +196,11 @@ def _save_checkpoint_body(path: str, params, batch_stats,
     # (ckpt_shard.py) writes version 2.
     flat["meta/format_version"] = np.asarray(GATHERED_FORMAT_VERSION,
                                              np.int64)
+    ds_blob = encode_data_state(data_state)
+    if ds_blob is not None:
+        # Extra meta key only — the load-side section partition ignores
+        # unknown meta/* entries, so old builds restore these files.
+        flat["meta/data_state_json"] = ds_blob
     return write_npz_hashed(path, flat)
 
 
@@ -356,4 +392,7 @@ def load_checkpoint(path: str, *, verify: bool = True) -> Checkpoint:
         opt_state=SGDState(_unflatten(sections["momentum"])),
         step=_scalar("meta/step"),
         epoch=_scalar("meta/epoch"),
+        data_state=decode_data_state(
+            z["meta/data_state_json"]
+            if "meta/data_state_json" in files else None),
     )

@@ -57,7 +57,8 @@ from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, model_axis_size
 from ..parallel.tp.plan import spec_to_json
 from ..optim.sgd import SGDState
 from .checkpoint import (Checkpoint, CheckpointError, _SECTIONS, _unflatten,
-                         open_npz, sha256_of_file, write_npz_hashed)
+                         decode_data_state, encode_data_state, open_npz,
+                         sha256_of_file, write_npz_hashed)
 
 SHARD_FORMAT_VERSION = 2
 INDEX_KEY = "meta/shard_index_json"
@@ -168,7 +169,9 @@ def _shard_for_slot(leaf, shard_dim: int, n_slots: int) -> Dict[int, Any]:
 
 def save_checkpoint_sharded(path: str, params, batch_stats, opt_state,
                             step: int, epoch: int, *, mesh: Mesh,
-                            tracer=None) -> Tuple[Optional[str], List[str]]:
+                            tracer=None,
+                            data_state: Optional[Dict[str, Any]] = None
+                            ) -> Tuple[Optional[str], List[str]]:
     """Write the sharded (v2) checkpoint: per-slot shard files + the head
     index at ``path``.  Returns ``(index_sha, shard_file_names)`` — the
     sha is ``None`` on processes that do not write the index (rank > 0).
@@ -183,11 +186,12 @@ def save_checkpoint_sharded(path: str, params, batch_stats, opt_state,
     tracer = tracer if tracer is not None else get_tracer()
     with tracer.span("ckpt_write", step=int(step), overlap=True):
         return _save_sharded_body(path, params, batch_stats, opt_state,
-                                  step, epoch, mesh=mesh)
+                                  step, epoch, mesh=mesh,
+                                  data_state=data_state)
 
 
 def _save_sharded_body(path, params, batch_stats, opt_state, step, epoch,
-                       *, mesh):
+                       *, mesh, data_state=None):
     m = model_axis_size(mesh)
     pid = jax.process_index()
     multi = jax.process_count() > 1
@@ -252,12 +256,16 @@ def _save_sharded_body(path, params, batch_stats, opt_state, step, epoch,
         "leaves": leaves_meta,
     }
     blob = np.frombuffer(json.dumps(index).encode(), dtype=np.uint8)
-    index_sha = write_npz_hashed(path, {
+    flat = {
         "meta/format_version": np.asarray(SHARD_FORMAT_VERSION, np.int64),
         "meta/step": np.asarray(int(step), np.int64),
         "meta/epoch": np.asarray(int(epoch), np.int64),
         INDEX_KEY: blob,
-    })
+    }
+    ds_blob = encode_data_state(data_state)
+    if ds_blob is not None:
+        flat["meta/data_state_json"] = ds_blob
+    index_sha = write_npz_hashed(path, flat)
     return index_sha, names
 
 
@@ -331,6 +339,9 @@ def read_shard_index(path: str) -> Optional[Dict[str, Any]]:
             index = json.loads(bytes(bytearray(z[INDEX_KEY])).decode())
             index["step"] = int(z["meta/step"])
             index["epoch"] = int(z["meta/epoch"])
+            index["data_state"] = decode_data_state(
+                z["meta/data_state_json"]
+                if "meta/data_state_json" in z.files else None)
             n_slots = int(index["n_slots"])
             for entry in index.get("leaves", {}).values():
                 entry["n_slots"] = n_slots
@@ -503,6 +514,7 @@ def assemble_checkpoint(path: str) -> Checkpoint:
         opt_state=SGDState(_unflatten(sections["momentum"])),
         step=int(index["step"]),
         epoch=int(index["epoch"]),
+        data_state=index.get("data_state"),
     )
 
 
@@ -588,6 +600,7 @@ def load_for_mesh(path: str, mesh: Mesh, *, param_specs=None,
             opt_state=SGDState(_unflatten(sections["momentum"])),
             step=int(index["step"]),
             epoch=int(index["epoch"]),
+            data_state=index.get("data_state"),
         )
     finally:
         for z in zs.values():
@@ -625,4 +638,5 @@ def _load_v1_for_mesh(path, mesh, target, probe) -> Checkpoint:
         opt_state=SGDState(place("momentum", ck.opt_state.momentum_buf)),
         step=ck.step,
         epoch=ck.epoch,
+        data_state=ck.data_state,
     )

@@ -96,7 +96,12 @@ class Trainer:
                  tracer=None,
                  live=None,
                  tp_plan=None,
-                 ckpt_format: str = "gathered"):
+                 ckpt_format: str = "gathered",
+                 drift_audit_every: int = 0,
+                 drift_action: str = "abort",
+                 guard_window: int = 64,
+                 guard_spike_factor: float = 0.0,
+                 guard_action: str = "rollback"):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -131,9 +136,29 @@ class Trainer:
         self.lineage = (CheckpointLineage(snapshot_path,
                                           keep=keep_checkpoints)
                         if snapshot_path else None)
-        self._health = StepHealthGuard(on_nan)
+        self._health = StepHealthGuard(on_nan, window=guard_window,
+                                       spike_factor=guard_spike_factor,
+                                       spike_action=guard_action,
+                                       metrics=self.metrics)
+        self._health.on_lr_backoff = self._apply_lr_backoff
         self._watchdog = watchdog
         self._preemption = preemption
+        self._seed = int(seed)
+        # Mid-epoch resume position (data_state): the batch offset the
+        # FIRST trained epoch starts at; 0 = the whole-epoch default.
+        self._resume_offset = 0
+        # (epoch, batch) positions the guard's rollback condemned — the
+        # streaming loop drops them instead of re-ingesting poisoned data.
+        self._skip_batches: set = set()
+        # epoch -> (first global step, start batch offset): the map from a
+        # flushed loss's global step back to its (epoch, batch) position.
+        self._epoch_origin: dict = {}
+        # Set by the streaming loop when a preemption notice stops it
+        # mid-epoch: (epoch, next unconsumed batch offset).
+        self._preempt_pending = None
+        # Batch offset a _restore_last_good() landed on (mid-epoch
+        # snapshots); train()'s loop consumes it for the replayed epoch.
+        self._pending_resume_offset = 0
         if ckpt_format not in ("gathered", "sharded"):
             raise ValueError(
                 f"ckpt_format must be 'gathered' or 'sharded', got "
@@ -160,11 +185,41 @@ class Trainer:
                     jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
                     jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
                     jnp.asarray(ckpt.step, jnp.int32))
-                self.start_epoch = ckpt.epoch + 1
+                ds = ckpt.data_state
+                if isinstance(ds, dict) and "epoch" in ds:
+                    # data_state IS the position to resume from: an
+                    # end-of-epoch save carries (epoch+1, 0) — identical
+                    # to the legacy epoch+1 rule — and a mid-epoch
+                    # emergency save carries (epoch, offset), which the
+                    # prefetch engine fast-forwards to, making the
+                    # resumed run bit-for-bit the uninterrupted one.
+                    self.start_epoch = int(ds["epoch"])
+                    self._resume_offset = int(ds.get("offset", 0))
+                    folds = int(ds.get("rng_folds", 0))
+                    # Reconstruct the step-RNG stream: each past restore
+                    # folded its ordinal into the key, so replay the
+                    # folds in order (0 folds = the pristine seed key —
+                    # the common case, and the bit-for-bit one).
+                    for i in range(1, folds + 1):
+                        self.rng = jax.random.fold_in(self.rng, i)
+                    self._health.restores = folds
+                else:
+                    # Pre-round-12 checkpoint: no data_state record.
+                    # Warned once, never an error — the file resumes at
+                    # the next epoch boundary exactly as it always did.
+                    self.start_epoch = ckpt.epoch + 1
+                    self._resume_offset = 0
+                    print("WARNING: checkpoint has no data_state record "
+                          "(written before round 12); resuming at the "
+                          "next epoch boundary", file=sys.stderr)
                 print(f"Resuming training from snapshot at Epoch "
                       f"{ckpt.epoch}"
                       + ("" if used == snapshot_path
                          else f" (fallback snapshot {used})"))
+                if self._resume_offset:
+                    print(f"Mid-epoch resume: fast-forwarding epoch "
+                          f"{self.start_epoch} to batch offset "
+                          f"{self._resume_offset}")
         # Host-side mirror of state.step: reading the device scalar would
         # block on the in-flight epoch (the exact stall the deferred loss
         # read removes), and the step count per epoch is host-known.
@@ -248,6 +303,36 @@ class Trainer:
                 (shard_update, self.grad_accum > 1)]
             self.train_step = build(model, sgd_config, lr_schedule, mesh,
                                     **kw)
+        # The guard's lr_backoff action rebuilds the jitted program with
+        # a scaled schedule — keep the builder and the unscaled schedule.
+        self._base_lr_schedule = lr_schedule
+        self._rebuild_step = lambda sched: build(model, sgd_config, sched,
+                                                 mesh, **kw)
+        if self.resident is not None and self._resume_offset:
+            raise ValueError(
+                "resident mode dispatches whole epochs and cannot "
+                f"fast-forward to batch offset {self._resume_offset} of a "
+                "mid-epoch checkpoint; resume this file with the "
+                "streaming loop (drop --resident)")
+        # Cross-replica SDC drift audit (resilience/drift.py): every K
+        # steps, bit-level per-replica parameter fingerprints compared
+        # over ``data`` with one tiny psum pair.
+        self._drift = None
+        if drift_audit_every:
+            if tp_plan is not None:
+                raise ValueError(
+                    "--drift_audit_every needs replicated parameters (the "
+                    "DP lockstep invariant it checks); it does not "
+                    "support a tensor-parallel plan yet")
+            if self.resident is not None:
+                raise ValueError(
+                    "--drift_audit_every audits at step boundaries, which "
+                    "the resident whole-epoch dispatch does not have; "
+                    "drop --resident to enable the drift audit")
+            from ..resilience.drift import DriftAuditor
+            self._drift = DriftAuditor(mesh, self.state.params,
+                                       every=drift_audit_every,
+                                       action=drift_action)
 
     def _ckpt_loader(self):
         """The lineage walk's candidate loader, bound to THIS run's mesh
@@ -265,9 +350,23 @@ class Trainer:
         return functools.partial(load_for_mesh, mesh=self.mesh,
                                  param_specs=specs)
 
-    def _epoch_losses_streaming(self):
+    def _apply_lr_backoff(self, scale: float) -> None:
+        """Guard ``lr_backoff`` hook: rebuild the jitted program with the
+        schedule scaled by the guard's cumulative factor.  A recompile —
+        but this fires only on an anomaly verdict, never in steady
+        state."""
+        base = self._base_lr_schedule
+        self.lr_schedule = lambda step: base(step) * scale
+        if self.resident is not None:
+            self.train_epoch = self._rebuild_step(self.lr_schedule)
+        else:
+            self.train_step = self._rebuild_step(self.lr_schedule)
+
+    def _epoch_losses_streaming(self, epoch: int, start: int = 0):
         """Per-step dispatch over host-fed batches (the reference's loop,
-        multigpu.py:104-107)."""
+        multigpu.py:104-107).  ``start`` is the mid-epoch resume offset
+        (data_state): the prefetch engine fast-forwards to batch
+        ``start`` without materialising the skipped prefix."""
         epoch_losses = []
         from ..data.prefetch import prefetch_to_device
         if self.grad_accum > 1:
@@ -284,7 +383,7 @@ class Trainer:
                 self.mesh, depth=self.prefetch_depth,
                 workers=self.prefetch_workers, stats=self.prefetch_stats,
                 shard_fn=shard_batch_stacked, tracer=self.tracer,
-                step0=self._host_step)
+                step0=self._host_step, start=start)
         else:
             # Worker pool augments + device_puts ahead of the loop (the
             # pin_memory/worker analogue, singlegpu.py:177); combined with
@@ -293,10 +392,30 @@ class Trainer:
             batches = prefetch_to_device(
                 self.train_loader, self.mesh, depth=self.prefetch_depth,
                 workers=self.prefetch_workers, stats=self.prefetch_stats,
-                tracer=self.tracer, step0=self._host_step)
+                tracer=self.tracer, step0=self._host_step, start=start)
         step = self._host_step
+        k = start  # epoch-local batch offset (the data_state coordinate)
         t_prev = time.monotonic()
         for device_batch in batches:
+            # Step-boundary preemption (resilience/preemption.py): checked
+            # BEFORE the dispatch, so batch k is the first UNCONSUMED one
+            # — exactly the offset the emergency data_state records.
+            # Single-process this is an Event read; multi-host every rank
+            # runs the same per-step collective (global step as the one
+            # sync-id space), so the stop is lockstep.
+            if self._preemption is not None and \
+                    self._preemption.should_stop_step(step, self.mesh):
+                self._preempt_pending = (epoch, k)
+                break
+            if (epoch, k) in self._skip_batches:
+                # Guard rollback condemned this batch: drop it instead of
+                # re-ingesting the poisoned window (the step counter does
+                # not advance — no optimizer update happened).
+                if self.metrics is not None:
+                    self.metrics.log_event("batch_skipped", epoch=epoch,
+                                           batch=k, step=step)
+                k += 1
+                continue
             # The dispatch span covers the jitted call only — enqueue
             # time plus whatever XLA makes it wait for (donated-buffer
             # availability, compile on the first step); together with
@@ -313,6 +432,15 @@ class Trainer:
                 self._live.step(now - t_prev, step=step)
                 t_prev = now
             step += 1
+            k += 1
+            if self._drift is not None and self._drift.due(step):
+                # Synchronous cross-replica fingerprint compare (drift.py)
+                # — the host read doubles as the XLA:CPU hazard drain, so
+                # no extra gate is needed before the audit program.
+                with self.tracer.span("drift_audit", step=step):
+                    self._drift.audit(self.state.params, step,
+                                      metrics=self.metrics,
+                                      guard=self._health)
             if self._watchdog is not None:
                 self._watchdog.beat()
         return jnp.stack(epoch_losses) if epoch_losses else None
@@ -368,15 +496,19 @@ class Trainer:
             parts.append(tail_loss)
         return jnp.concatenate(parts) if parts else None
 
-    def _run_epoch(self, epoch: int) -> None:
+    def _run_epoch(self, epoch: int, start_offset: int = 0) -> None:
         b_sz = self.train_loader.per_replica_batch
         # Reference epoch header (multigpu.py:102) — without materialising
         # and discarding a probe batch to learn b_sz (multigpu.py:101).
         print(f"[GPU{self.gpu_id}] Epoch {epoch} | Batchsize: {b_sz} | "
               f"Steps: {len(self.train_loader)}")
+        # Global-step -> (epoch, batch) origin, for mapping a flushed
+        # loss's step back to its data position (guard rollback's skip
+        # window, mid-epoch data_state).
+        self._epoch_origin[epoch] = (self._host_step, start_offset)
         self.train_loader.set_epoch(epoch)
         stacked = (self._epoch_losses_resident() if self.resident is not None
-                   else self._epoch_losses_streaming())
+                   else self._epoch_losses_streaming(epoch, start_offset))
         n_losses = int(stacked.shape[0]) if stacked is not None else 0
         start_step = self._host_step
         self._host_step += n_losses
@@ -457,15 +589,29 @@ class Trainer:
                     dist.abort()  # non-graceful: never blocks (dist.py)
                 raise err
 
-    def _save_checkpoint(self, epoch: int) -> None:
+    def _data_state(self, epoch: int, offset: int) -> dict:
+        """The checkpoint's resume-position record: start training at
+        batch ``offset`` of ``epoch`` (an end-of-epoch save is
+        ``(epoch + 1, 0)``), with the sampler seed and the number of
+        restore RNG folds needed to reconstruct the step-key stream."""
+        return {"version": 1, "epoch": int(epoch), "offset": int(offset),
+                "seed": self._seed,
+                "rng_folds": int(self._health.restores)}
+
+    def _save_checkpoint(self, epoch: int, data_state: dict = None) -> None:
         # The serial span covers the main-thread part only (device sync,
         # snapshot copies, joining the previous writer); the file write
         # itself runs on the writer thread and records its own
         # overlap=True ckpt_write span from save_checkpoint.
         with self.tracer.span("ckpt_write", step=self._host_step):
-            self._save_checkpoint_inner(epoch)
+            self._save_checkpoint_inner(epoch, data_state)
 
-    def _save_checkpoint_inner(self, epoch: int) -> None:
+    def _save_checkpoint_inner(self, epoch: int,
+                               data_state: dict = None) -> None:
+        if data_state is None:
+            # The default save site is the end-of-epoch gate: the resume
+            # position is the NEXT epoch's first batch.
+            data_state = self._data_state(epoch + 1, 0)
         # XLA:CPU hazard gate — BEFORE anything (the ZeRO conversion
         # below included) enqueues work behind the in-flight epoch: the
         # CPU backend executes per-device programs on a shared thread
@@ -559,17 +705,19 @@ class Trainer:
                     sha, shard_names = save_checkpoint_sharded(
                         self.snapshot_path, snap_params, snap_stats,
                         SGDState(snap_opt), step, epoch, mesh=self.mesh,
-                        tracer=self.tracer)
+                        tracer=self.tracer, data_state=data_state)
                 else:
                     sha = save_checkpoint(self.snapshot_path, snap_params,
                                           snap_stats, SGDState(snap_opt),
-                                          step, epoch, tracer=self.tracer)
+                                          step, epoch, tracer=self.tracer,
+                                          data_state=data_state)
                     shard_names = None
                 if self.gpu_id != 0:
                     return  # shard writer only: no lineage, no print
                 if self.lineage is not None:
                     self.lineage.commit(epoch=epoch, step=step, sha256=sha,
-                                        shards=shard_names)
+                                        shards=shard_names,
+                                        data_state=data_state)
                 # Reference print, singlegpu.py:122.
                 print(f"Epoch {epoch} | Training checkpoint saved at "
                       f"{self.snapshot_path}")
@@ -580,15 +728,18 @@ class Trainer:
         self._save_thread.start()
 
     def _restore_last_good(self) -> int:
-        """``--on_nan restore``: reload the newest verifiable checkpoint
-        (lineage fall-back included), re-seed the step RNG, and return the
-        epoch to resume from.  Runs identically on every rank (the
-        non-finite verdict came from replicated losses), so multi-host
-        stays in lockstep."""
+        """``--on_nan restore`` / guard rollback / drift restore: reload
+        the newest verifiable checkpoint (lineage fall-back included),
+        re-seed the step RNG, and return the epoch to resume from (the
+        batch offset, for a mid-epoch snapshot, lands in
+        ``self._pending_resume_offset``).  Runs identically on every rank
+        (the verdict came from replicated losses/fingerprints), so
+        multi-host stays in lockstep."""
         from ..resilience.guard import NonFiniteLossError
         from ..resilience.lineage import latest_verifiable
         self._join_pending_save()  # let any in-flight (good) write land
         self._pending_losses = None  # the poisoned trajectory's records
+        self._preempt_pending = None
         loaded = (latest_verifiable(self.snapshot_path,
                                     loader=self._ckpt_loader())
                   if self.snapshot_path else None)
@@ -635,13 +786,25 @@ class Trainer:
                                    epoch=ckpt.epoch, step=ckpt.step,
                                    snapshot=used,
                                    restores=self._health.restores)
+        ds = ckpt.data_state
+        if isinstance(ds, dict) and "epoch" in ds:
+            self._pending_resume_offset = int(ds.get("offset", 0))
+            return int(ds["epoch"])
+        self._pending_resume_offset = 0
         return ckpt.epoch + 1
 
-    def _train_one(self, epoch: int, epoch_callback) -> None:
+    def _train_one(self, epoch: int, epoch_callback,
+                   start_offset: int = 0) -> None:
         if self._watchdog is not None:
             self._watchdog.beat()
         t_epoch = self.tracer.now()  # straggler-window marker
-        self._run_epoch(epoch)
+        self._run_epoch(epoch, start_offset=start_offset)
+        if self._preempt_pending is not None:
+            # The streaming loop stopped mid-epoch on a preemption
+            # notice: the epoch is NOT complete, so the normal save gate
+            # below must not write an end-of-epoch data_state — take the
+            # mid-epoch emergency checkpoint and exit instead (raises).
+            self._emergency_checkpoint_midepoch()
         # NB: like the reference, epoch 0 satisfies the modulo gate
         # — snapshot_path=None disables checkpointing entirely.
         if self.snapshot_path and epoch % self.save_every == 0:
@@ -667,8 +830,18 @@ class Trainer:
             # COLLECTIVE on multi-host (resilience/preemption.py): every
             # rank calls it at every epoch boundary so the stop decision —
             # and the emergency save's collective canonicalisation — run
-            # in lockstep.
-            if self._preemption.should_stop(epoch, self.mesh):
+            # in lockstep.  The streaming loop also checks per step; this
+            # boundary check catches a notice that landed after the
+            # epoch's last dispatch, keeping the completed epoch's
+            # checkpoint as the emergency state.  Resident mode keeps the
+            # epoch-granular sync-id space (its dispatch unit); streaming
+            # uses the global-step space throughout so the two never mix
+            # sync counters.
+            stop = (self._preemption.should_stop(epoch, self.mesh)
+                    if self.resident is not None else
+                    self._preemption.should_stop_step(self._host_step,
+                                                      self.mesh))
+            if stop:
                 self._emergency_checkpoint(epoch)
 
     def _log_stragglers(self, epoch: int, since: float) -> None:
@@ -725,6 +898,54 @@ class Trainer:
         self.tracer.flush(fsync=True)  # same durability for the span tail
         raise PreemptionInterrupt(epoch, self.snapshot_path)
 
+    def _emergency_checkpoint_midepoch(self) -> None:
+        """Step-boundary preemption exit: the streaming loop stopped with
+        the epoch partially trained.  Flush + health-check the partial
+        losses (the on-disk state must stay loss-verified), save with a
+        mid-epoch ``data_state`` naming the first unconsumed batch, and
+        raise :class:`PreemptionInterrupt`."""
+        from ..resilience.preemption import PreemptionInterrupt
+        epoch, k = self._preempt_pending
+        self._preempt_pending = None
+        # Lands the previous epoch's deferred losses AND this epoch's
+        # partial vector — both health-checked before the save, keeping
+        # the every-checkpoint-is-loss-verified invariant at step
+        # granularity.
+        self.flush_losses()
+        if self.snapshot_path:
+            self._save_checkpoint(epoch,
+                                  data_state=self._data_state(epoch, k))
+        self._join_pending_save()  # async write must land before we exit
+        print(f"[GPU{self.gpu_id}] preemption: mid-epoch emergency "
+              f"checkpoint at epoch {epoch}, batch offset {k} (global "
+              f"step {self._host_step})"
+              + (f" is on disk at {self.snapshot_path}"
+                 if self.snapshot_path
+                 else " — DISABLED (snapshot_path=None), state lost"),
+              file=sys.stderr)
+        if self.metrics is not None:
+            self.metrics.log_event("preemption_checkpoint", epoch=epoch,
+                                   step=self._host_step, offset=k,
+                                   snapshot=self.snapshot_path)
+            self.metrics.fsync()
+        self.tracer.flush(fsync=True)
+        raise PreemptionInterrupt(epoch, self.snapshot_path)
+
+    def _mark_poisoned(self, epoch, steps) -> None:
+        """Map a rollback verdict's global steps to their ``(epoch,
+        batch)`` data positions and condemn them — the streaming loop
+        drops condemned batches on the replay."""
+        origin = self._epoch_origin.get(epoch)
+        if origin is None:
+            return
+        start_step, start_offset = origin
+        marked = [(int(epoch), start_offset + int(s) - start_step)
+                  for s in steps]
+        self._skip_batches.update(marked)
+        print(f"[GPU{self.gpu_id}] guard rollback: skipping poisoned "
+              f"batch window {[m[1] for m in marked[:8]]} of epoch "
+              f"{epoch} on replay", file=sys.stderr)
+
     def train(self, max_epochs: int, epoch_callback=None) -> None:
         """Reference ``Trainer.train`` (multigpu.py:115-119): epoch loop with
         the rank-0 ``save_every`` checkpoint gate.  ``epoch_callback(epoch)``
@@ -735,16 +956,22 @@ class Trainer:
         from ..resilience.guard import RestoreFromLastGood
         try:
             epoch = self.start_epoch
+            offset = self._resume_offset  # mid-epoch data_state position
             while epoch < max_epochs:
                 try:
-                    self._train_one(epoch, epoch_callback)
+                    self._train_one(epoch, epoch_callback,
+                                    start_offset=offset)
+                    offset = 0
                     epoch += 1
                     if epoch == max_epochs:
                         # Final flush inside the guard: a poisoned LAST
                         # epoch still gets its policy applied.
                         self.flush_losses()
-                except RestoreFromLastGood:
+                except RestoreFromLastGood as e:
+                    if getattr(e, "skip_steps", None):
+                        self._mark_poisoned(e.skip_epoch, e.skip_steps)
                     epoch = self._restore_last_good()
+                    offset = self._pending_resume_offset
         finally:
             # The last checkpoint write must be on disk before train()
             # returns (resume and the reference's artifact contract depend

@@ -50,6 +50,52 @@ def test_stream_bit_identical_across_settings(depth, workers):
         _assert_streams_equal(got, want)
 
 
+@pytest.mark.parametrize("depth,workers", [(0, 1), (2, 4), (5, 3)])
+@pytest.mark.parametrize("start", [1, 3, 7])
+def test_fast_forward_yields_identical_suffix(depth, workers, start):
+    """Mid-epoch resume contract (round 12): ``start=k`` yields exactly
+    the suffix ``[k, n)`` of the unoffset stream, bit for bit, on every
+    engine path — batch content is a function of (seed, epoch, k) alone,
+    so fast-forwarding replays nothing and changes nothing."""
+    ds, _ = synthetic(n_train=100, n_test=8)  # ragged tail included
+    mesh = make_mesh(2)
+    loader = TrainLoader(ds, per_replica_batch=8, num_replicas=2, seed=5)
+    loader.set_epoch(1)
+    want = [loader.materialize(k) for k in range(start, len(loader))]
+    loader.set_epoch(1)
+    got = _collect(prefetch_to_device(loader, mesh, depth=depth,
+                                      workers=workers, start=start))
+    _assert_streams_equal(got, want)
+
+
+@pytest.mark.parametrize("depth", [0, 2])
+def test_fast_forward_threaded_iterable_suffix(depth):
+    """A plain iterable (no random access) still fast-forwards: the
+    skipped prefix is materialised-but-dropped, the suffix identical."""
+    ds, _ = synthetic(n_train=64, n_test=8)
+    mesh = make_mesh(2)
+    loader = TrainLoader(ds, per_replica_batch=8, num_replicas=2, seed=1)
+    loader.set_epoch(0)
+    want = [loader.materialize(k) for k in range(len(loader))]
+    got = _collect(prefetch_to_device(iter(want), mesh, depth=depth,
+                                      start=2))
+    _assert_streams_equal(got, want[2:])
+
+
+def test_fast_forward_past_end_is_empty_stream():
+    """start >= len: nothing to replay — an empty stream, not an error
+    (the resume-at-final-batch edge of the emergency data_state)."""
+    ds, _ = synthetic(n_train=64, n_test=8)
+    mesh = make_mesh(2)
+    loader = TrainLoader(ds, per_replica_batch=8, num_replicas=2, seed=1)
+    loader.set_epoch(0)
+    assert _collect(prefetch_to_device(loader, mesh, depth=2,
+                                       start=len(loader))) == []
+    loader.set_epoch(0)
+    assert _collect(prefetch_to_device(iter(list(loader)), mesh, depth=1,
+                                       start=99)) == []
+
+
 def test_threaded_path_matches_iterable():
     """A generic iterable (no materialize) takes the single-thread path
     and must yield the same stream."""

@@ -28,7 +28,9 @@ from ddp_tpu.optim import SGDConfig, triangular_lr
 from ddp_tpu.optim.sgd import SGDState
 from ddp_tpu.parallel import dist, make_mesh
 from ddp_tpu.resilience import faults
-from ddp_tpu.resilience.guard import NonFiniteLossError, StepHealthGuard
+from ddp_tpu.resilience.drift import DriftDetectedError, leaf_paths
+from ddp_tpu.resilience.guard import (LossSpikeError, NonFiniteLossError,
+                                      RestoreFromLastGood, StepHealthGuard)
 from ddp_tpu.resilience.lineage import (CheckpointLineage,
                                         load_latest_verifiable)
 from ddp_tpu.resilience.preemption import (PreemptionGuard,
@@ -178,8 +180,9 @@ def test_rotation_never_touches_unlisted_or_inflight_files(tmp_path):
 
 def _make_trainer(path, epochs, seed=0, resume=False, keep=1,
                   on_nan="abort", preemption=None, save_every=1,
-                  ckpt_format="gathered"):
-    """test_checkpoint.py's DeepNN trainer, resilience knobs exposed."""
+                  ckpt_format="gathered", **extra):
+    """test_checkpoint.py's DeepNN trainer, resilience knobs exposed
+    (``extra`` reaches the Trainer ctor: metrics, drift/guard knobs)."""
     train_ds, _ = synthetic(n_train=256, seed=1)
     mesh = make_mesh(8)
     model = get_model("deepnn")
@@ -192,7 +195,7 @@ def _make_trainer(path, epochs, seed=0, resume=False, keep=1,
                    sgd_config=SGDConfig(lr=0.05), save_every=save_every,
                    snapshot_path=path, resume=resume,
                    keep_checkpoints=keep, on_nan=on_nan,
-                   preemption=preemption, ckpt_format=ckpt_format)
+                   preemption=preemption, ckpt_format=ckpt_format, **extra)
 
 
 def _params_equal(a, b):
@@ -395,6 +398,233 @@ def test_preemption_drill_resume_matches_uninterrupted(tmp_path, capfd):
     assert int(t_full.state.step) == int(t_res.state.step)
 
 
+# -- round 12: mid-epoch checkpoint/resume, drift audit, spike guard ------
+
+
+@pytest.fixture(scope="module")
+def full_run_ref(tmp_path_factory):
+    """The uninterrupted 3-epoch run every mid-epoch drill compares
+    against (one compile+train for the whole module)."""
+    path = str(tmp_path_factory.mktemp("ref") / "full.pt")
+    tr = _make_trainer(path, epochs=3, save_every=100)
+    tr.train(3)
+    return jax.device_get(tr.state.params), int(tr.state.step)
+
+
+@pytest.mark.parametrize("fmt", ["gathered", "sharded"])
+@pytest.mark.parametrize("kill_step", [5, 9])
+def test_midepoch_preemption_resume_bit_identical(tmp_path, capfd,
+                                                  full_run_ref, fmt,
+                                                  kill_step):
+    """Acceptance (round 12): SIGTERM mid-epoch -> emergency checkpoint
+    at the NEXT STEP boundary carrying a data_state (epoch, offset, seed,
+    rng_folds); --resume fast-forwards the epoch to that exact batch and
+    lands bit-for-bit on the uninterrupted run's final state — at two
+    kill points, in both checkpoint formats."""
+    want_params, want_step = full_run_ref
+    path = str(tmp_path / "half.pt")
+    guard = PreemptionGuard().install()
+    try:
+        half = _make_trainer(path, epochs=3, save_every=100,
+                             preemption=guard, ckpt_format=fmt)
+        steps = len(half.train_loader)
+        faults.sigterm_at_step(half, kill_step)
+        with pytest.raises(PreemptionInterrupt):
+            half.train(3)
+    finally:
+        guard.uninstall()
+    err = capfd.readouterr().err
+    assert "preemption notice" in err and "emergency checkpoint" in err
+    ck = load_checkpoint(path)
+    ds = ck.data_state
+    assert ds is not None and ds["version"] == 1
+    # The stop lands on the signal's step boundary (the OS may deliver
+    # one dispatch late) and MID-epoch: a nonzero batch offset.
+    stopped_at = ds["epoch"] * steps + ds["offset"]
+    assert kill_step <= stopped_at <= kill_step + 2
+    assert 0 < ds["offset"] < steps
+    assert ds["rng_folds"] == 0 and ds["seed"] == 0
+    # Satellite: the lineage manifest's head entry mirrors the record.
+    man = json.load(open(path + ".manifest.json"))
+    assert man["head"]["data_state"] == ds
+
+    res = _make_trainer(path, epochs=3, save_every=100, resume=True,
+                        ckpt_format=fmt)
+    assert res.start_epoch == ds["epoch"]
+    assert res._resume_offset == ds["offset"]
+    res.train(3)
+    assert "fast-forwarding" in capfd.readouterr().out
+    _params_equal(want_params, res.state.params)
+    assert int(res.state.step) == want_step
+
+
+def test_torn_data_state_degrades_to_epoch_boundary(tmp_path, capfd):
+    """A torn/unparseable data_state record is treated as ABSENT: resume
+    falls back to the epoch-boundary semantics with a warning — never an
+    error (MIGRATING.md contract)."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=2)
+    tr.train(2)
+    faults.torn_data_state(path)
+    res = _make_trainer(path, epochs=2, resume=True)
+    err = capfd.readouterr().err
+    assert "no data_state record" in err
+    assert res.start_epoch == 2 and res._resume_offset == 0
+
+
+def test_legacy_checkpoint_missing_data_state_warns(tmp_path, capfd):
+    """A pre-round-12 checkpoint (key absent, not torn) resumes at the
+    next epoch boundary with the one-line warning."""
+    from ddp_tpu.train.checkpoint import write_npz_hashed
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=2)
+    tr.train(2)
+    with np.load(path) as z:
+        flat = {k: z[k] for k in z.files if k != "meta/data_state_json"}
+    write_npz_hashed(path, flat)
+    res = _make_trainer(path, epochs=2, resume=True)
+    err = capfd.readouterr().err
+    assert "no data_state record" in err and "epoch boundary" in err
+    assert res.start_epoch == 2 and res._resume_offset == 0
+
+
+def _events(path):
+    return [json.loads(line) for line in open(path)]
+
+
+def test_drift_audit_detects_flip_within_k_and_aborts(tmp_path, capfd):
+    """Acceptance (round 12 SDC drill): one flipped parameter bit on one
+    virtual replica is detected within K steps of the next audit, the
+    drift_detected event names the offending leaf path and replica, and
+    --drift_action abort fails fast with the event already on disk."""
+    from ddp_tpu.utils.metrics import MetricsLogger
+    path = str(tmp_path / "ck.pt")
+    mpath = str(tmp_path / "m.jsonl")
+    metrics = MetricsLogger(mpath)
+    tr = _make_trainer(path, epochs=3, metrics=metrics,
+                       drift_audit_every=2)
+    bad_leaf = leaf_paths(tr.state.params)[0]
+    faults.flip_param_bit(tr, 5, replica=1)
+    with pytest.raises(DriftDetectedError, match="drift"):
+        tr.train(3)
+    metrics.close()
+    assert "silent data corruption" in capfd.readouterr().err
+    ev = [e for e in _events(mpath) if e.get("event") == "drift_detected"]
+    assert len(ev) == 1
+    assert ev[0]["step"] <= 5 + 2  # within K=2 steps of the flip
+    assert bad_leaf in ev[0]["leaves"]
+    assert ev[0]["replicas"] == [1]
+
+
+def test_drift_audit_restore_recovers_and_completes(tmp_path):
+    """--drift_action restore: roll back to the last verified snapshot
+    (sharing the guard's restore budget) and complete the run with zero
+    non-finite losses in the flushed metrics."""
+    from ddp_tpu.utils.metrics import MetricsLogger
+    path = str(tmp_path / "ck.pt")
+    mpath = str(tmp_path / "m.jsonl")
+    metrics = MetricsLogger(mpath)
+    tr = _make_trainer(path, epochs=3, metrics=metrics,
+                       drift_audit_every=2, drift_action="restore")
+    faults.flip_param_bit(tr, 5, replica=2)
+    tr.train(3)
+    metrics.close()
+    assert tr._drift.detections == 1
+    assert tr._health.restores == 1  # shared budget consumed
+    assert int(tr.state.step) == 3 * len(tr.train_loader)
+    losses = [e["loss"] for e in _events(mpath) if "loss" in e]
+    assert losses and all(np.isfinite(l) for l in losses)
+
+
+def test_drift_audit_rejects_resident_mode(tmp_path):
+    """The audit needs step boundaries; the resident whole-epoch scan has
+    none — refused at construction, not silently skipped."""
+    train_ds, _ = synthetic(n_train=256, seed=1)
+    mesh = make_mesh(8)
+    model = get_model("deepnn")
+    params, stats = model.init(jax.random.key(0))
+    loader = TrainLoader(train_ds, per_replica_batch=8, num_replicas=8,
+                         augment=False, seed=0)
+    sched = functools.partial(triangular_lr, base_lr=0.05, num_epochs=1,
+                              steps_per_epoch=len(loader))
+    with pytest.raises(ValueError, match="drift_audit_every"):
+        Trainer(model, loader, params, stats, mesh=mesh,
+                lr_schedule=sched, sgd_config=SGDConfig(lr=0.05),
+                snapshot_path=str(tmp_path / "ck.pt"),
+                resident=True, device_augment=True,
+                drift_audit_every=10)
+
+
+def test_guard_spike_rollback_skips_poisoned_window(tmp_path, capfd):
+    """A poisoned batch spikes the loss; --guard_action rollback restores
+    the last verified snapshot and SKIPS the condemned batch window on
+    replay (re-ingesting it would just spike again)."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=4, guard_spike_factor=2.0,
+                       guard_action="rollback", guard_window=8)
+    steps = len(tr.train_loader)
+    faults.poison_batch(tr, 2 * steps + 1, scale=40)
+    tr.train(4)
+    err = capfd.readouterr().err
+    assert "poisoned batch window" in err
+    assert tr._health.decisions["spike_rollback"] == 1
+    assert tr._health.last_decision.startswith("spike_rollback@")
+    # The condemned batches never re-dispatched: fewer optimizer steps
+    # than the uninterrupted run, and every surviving loss is finite.
+    assert int(tr.state.step) < 4 * steps
+    assert all(np.isfinite(l) for l in tr.loss_history)
+
+
+def test_guard_spike_abort_and_skip():
+    """Series-level unit: the rolling median/MAD detector flags a spike
+    after _MIN_WINDOW history; abort raises, skip keeps the outlier OUT
+    of the window so the baseline doesn't inflate."""
+    g = StepHealthGuard(window=8, spike_factor=2.0, spike_action="abort")
+    g.check_series("loss", [1.0] * 8, list(range(8)), epoch=0)
+    with pytest.raises(LossSpikeError, match="guard_action abort"):
+        g.check_series("loss", [50.0], [8], epoch=0)
+
+    g2 = StepHealthGuard(window=8, spike_factor=2.0, spike_action="skip")
+    g2.check_series("loss", [1.0] * 8, list(range(8)), epoch=0)
+    g2.check_series("loss", [50.0], [8], epoch=0)  # logged, not raised
+    assert g2.decisions["spike_skip"] == 1
+    # The spike stayed out of the window: a normal value is still normal.
+    g2.check_series("loss", [1.1], [9], epoch=0)
+    assert g2.decisions["spike_skip"] == 1
+
+
+def test_guard_lr_backoff_halves_schedule_scale():
+    calls = []
+    g = StepHealthGuard(window=8, spike_factor=2.0,
+                        spike_action="lr_backoff")
+    g.check_series("loss", [1.0] * 8, list(range(8)), epoch=0)
+    # No trainer hook installed: degrades to a logged skip.
+    g.check_series("loss", [50.0], [8], epoch=0)
+    assert g.lr_scale == 1.0 and g.decisions["spike_skip"] == 1
+    g.on_lr_backoff = calls.append
+    g.check_series("loss", [50.0], [9], epoch=0)
+    assert g.lr_scale == 0.5 and calls == [0.5]
+    assert g.decisions["spike_lr_backoff"] == 1
+
+
+def test_guard_rollback_names_the_poisoned_steps():
+    g = StepHealthGuard(window=8, spike_factor=2.0,
+                        spike_action="rollback")
+    g.check_series("loss", [1.0] * 8, list(range(80, 88)), epoch=3)
+    with pytest.raises(RestoreFromLastGood) as ei:
+        g.check_series("loss", [50.0, 60.0], [88, 89], epoch=3)
+    assert ei.value.skip_steps == [88, 89]
+    assert ei.value.skip_epoch == 3
+    assert g.restores == 1  # shares the --on_nan restore budget
+
+
+def test_guard_rejects_bad_spike_knobs():
+    with pytest.raises(ValueError, match="guard_action"):
+        StepHealthGuard(window=8, spike_action="explode")
+    with pytest.raises(ValueError, match="guard_spike_factor"):
+        StepHealthGuard(window=8, spike_factor=-1.0)
+
+
 def test_preemption_guard_second_signal_restores_previous_handler():
     prev = signal.getsignal(signal.SIGTERM)
     guard = PreemptionGuard(signals=(signal.SIGTERM,)).install()
@@ -591,6 +821,79 @@ def test_cli_preemption_exit_status_and_resume(tmp_path):
     got = load_checkpoint(str(tmp_path / "int.pt"))
     _params_equal(want.params, got.params)
     assert want.step == got.step
+
+
+@pytest.mark.slow
+def test_cli_midepoch_preemption_resume_bit_identical(tmp_path):
+    """Round-12 CI drill through the real CLI: SIGTERM at a STEP inside
+    epoch 1 -> emergency checkpoint with a mid-epoch data_state + exit
+    75; --resume fast-forwards to the unconsumed batch and lands on the
+    SAME final state as the uninterrupted run."""
+    common = ["3", "1", "--batch_size", "4", "--synthetic", "--model",
+              "deepnn", "--lr", "0.05", "--synthetic_size", "64",
+              "--seed", "3"]
+    env = _clean_env(8)
+
+    def run_cli(snapshot, extra=(), fault=None):
+        e = dict(env)
+        if fault:
+            e[faults.FAULT_ENV] = fault
+        return subprocess.run(
+            [sys.executable, "multigpu.py", *common, *extra,
+             "--snapshot_path", str(tmp_path / snapshot)],
+            cwd=_REPO, env=e, capture_output=True, text=True, timeout=600)
+
+    full = run_cli("full.pt")
+    assert full.returncode == 0, (full.stdout[-2000:], full.stderr[-2000:])
+
+    # 2 steps/epoch (64 / (4*8)): step 2 is the first batch of epoch 1,
+    # so the stop boundary lands mid-epoch at (epoch 1, offset 1).
+    interrupted = run_cli("int.pt", fault="sigterm@step=2")
+    assert interrupted.returncode == 75, (interrupted.stdout[-2000:],
+                                          interrupted.stderr[-2000:])
+    assert "emergency checkpoint" in interrupted.stderr
+    ds = load_checkpoint(str(tmp_path / "int.pt")).data_state
+    assert ds["epoch"] == 1 and ds["offset"] == 1
+
+    resumed = run_cli("int.pt", extra=["--resume"])
+    assert resumed.returncode == 0, (resumed.stdout[-2000:],
+                                     resumed.stderr[-2000:])
+    assert "fast-forwarding epoch 1 to batch offset 1" in resumed.stdout
+
+    want = load_checkpoint(str(tmp_path / "full.pt"))
+    got = load_checkpoint(str(tmp_path / "int.pt"))
+    _params_equal(want.params, got.params)
+    assert want.step == got.step
+
+
+@pytest.mark.slow
+def test_cli_sdc_drill_flip_detected_and_restored(tmp_path):
+    """Round-12 CI drill: a flipped parameter bit on one virtual replica
+    is caught by the drift audit within K steps, the drift_detected
+    event (leaf paths + replica) lands in the metrics spill, and
+    --drift_action restore rolls back and completes with exit 0 and
+    finite losses."""
+    env = _clean_env(8)
+    env[faults.FAULT_ENV] = "flip_param_bit@step=2,replica=1"
+    mpath = str(tmp_path / "metrics.jsonl")
+    out = subprocess.run(
+        [sys.executable, "multigpu.py", "3", "1", "--batch_size", "4",
+         "--synthetic", "--model", "deepnn", "--lr", "0.05",
+         "--synthetic_size", "64", "--seed", "3",
+         "--drift_audit_every", "2", "--drift_action", "restore",
+         "--metrics_path", mpath,
+         "--snapshot_path", str(tmp_path / "sdc.pt")],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert "silent data corruption" in out.stderr
+    records = [json.loads(line) for line in open(mpath)]
+    ev = [r for r in records if r.get("event") == "drift_detected"]
+    assert len(ev) == 1
+    assert ev[0]["action"] == "restore" and ev[0]["replicas"] == [1]
+    assert ev[0]["leaves"]  # offending leaf paths are named
+    assert ev[0]["step"] <= 2 + 1 + 2  # within K=2 of the corrupt step
+    losses = [r["loss"] for r in records if "loss" in r]
+    assert losses and all(np.isfinite(l) for l in losses)
 
 
 @pytest.mark.slow
