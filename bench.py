@@ -78,10 +78,11 @@ BASELINE_BENCH_BF16 = 30372.0
 
 # FLOP model + measured MXU peaks: single home in ddp_tpu/obs/live.py
 # (round 7) so the LIVE MFU the trainer emits every --log_every steps and
-# the offline bench MFU can never disagree on the denominator.  Re-bound
-# here so existing consumers of bench.TRAIN_GFLOP_PER_SAMPLE keep working.
+# the offline bench MFU can never disagree on the denominator.  The
+# per-sample FLOP count is now derived from the model's counted jaxpr
+# (train_gflop_per_sample), not a hardcoded constant.
 from ddp_tpu.obs.live import (PEAK_TFLOPS_BF16_PASS,  # noqa: F401
-                              TRAIN_GFLOP_PER_SAMPLE, model_mfu)
+                              model_mfu, train_gflop_per_sample)
 
 
 def _parse_args():
@@ -157,6 +158,14 @@ def _parse_args():
                         "(deepnn unless --model overrides); on a CPU "
                         "host set XLA_FLAGS=--xla_force_host_platform_"
                         "device_count=8 for the full (2,4)x8 registry")
+    p.add_argument("--ledger_spill", default=None, metavar="SPILL",
+                   help="(--calibrate_cost only) also join this span "
+                        "spill (--trace_spill output of a traced run) "
+                        "against the freshly fitted predictions into the "
+                        "efficiency ledger (obs/ledger.py) and embed it "
+                        "in the JSON record — predictions scaled by the "
+                        "mesh's device count (virtual-mesh shard "
+                        "serialization)")
     p.add_argument("--guard_overhead", action="store_true",
                    help="Round 12: price the step-level fault domain on "
                         "the steady-state step loop — ms/step with the "
@@ -1662,7 +1671,7 @@ def _bench_calibrate_cost(args) -> None:
     t_l = min(window(w_long) for _ in range(repeats))
     measured_ms = max(t_l - t_s, 0.0) / (w_long - w_short) * 1e3
 
-    print(json.dumps({
+    record = {
         "metric": f"{model_name} cost-model calibration: predicted vs "
                   f"measured ms/step ({n_dev}-device "
                   f"{jax.default_backend()} mesh)",
@@ -1680,7 +1689,19 @@ def _bench_calibrate_cost(args) -> None:
             "elementwise_s_per_byte": c_byte,
             "collective_s_per_payload_byte": c_coll,
         },
-    }))
+    }
+    if getattr(args, "ledger_spill", None):
+        # The efficiency ledger: measured spans vs these predictions,
+        # per phase, with the mesh's serialization factor applied.
+        from ddp_tpu.obs.export import read_spill
+        from ddp_tpu.obs.ledger import build_ledger
+        try:
+            spans = read_spill([args.ledger_spill])
+            record["ledger"] = build_ledger(spans, record,
+                                            pred_scale=float(n_dev))
+        except (OSError, ValueError) as e:
+            record["ledger_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

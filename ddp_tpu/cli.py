@@ -702,7 +702,13 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                  tracer, tp_plan=None) -> float:
     """The trainer-lifetime tail of :func:`_run_body`, inside the
     preemption guard's install/uninstall bracket."""
+    from .obs.registry import MetricsRegistry
     from .resilience.watchdog import Watchdog
+    # One metrics registry per run: prefetch/guard/drift/watchdog mirror
+    # their counters here, and the end-of-run exposition lands next to
+    # the metrics JSONL (<metrics_path>.prom) so a run's final counter
+    # state is scrapeable after the process exits.
+    registry = MetricsRegistry()
     # A stall report that names the last completed span per host turns
     # "exit 124" into a diagnosis — wired only when the tracer is live.
     # on_expire force-lands the spill tail: the watchdog dies via
@@ -744,7 +750,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
     watchdog = (Watchdog(args.watchdog_secs,
                          context=_stall_context,
                          on_expire=(_flush_spill_bounded if tracer.enabled
-                                    else None))
+                                    else None),
+                         registry=registry)
                 if args.watchdog_secs > 0 else None)
     # Live telemetry (obs/live.py): the PrefetchStats occupancy counters
     # feed the per-step metrics stream instead of dying with the engine
@@ -768,7 +775,7 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         # The occupancy counters are only allocated when something will
         # read them (the LiveStats emitter) — otherwise the prefetch hot
         # path keeps its stats=None fast path (no perf_counter pairs).
-        pstats = PrefetchStats()
+        pstats = PrefetchStats(registry=registry)
         # One live 'step' is one optimizer step: under --grad_accum it
         # consumes A micro-batches, so the samples/sec numerator scales.
         live = LiveStats(metrics,
@@ -809,7 +816,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       guard_spike_factor=getattr(args,
                                                  "guard_spike_factor", 0.0),
                       guard_action=getattr(args, "guard_action",
-                                           "rollback"))
+                                           "rollback"),
+                      registry=registry)
     trainer_ref.append(trainer)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
@@ -913,4 +921,14 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         # tf.summary writer buffers minutes of scalars (the JSONL handle
         # is line-buffered).
         metrics.close()
+        # End-of-run scrape file: the registry's final exposition, next
+        # to the metrics JSONL (rank 0 — same gate as the JSONL itself).
+        if args.metrics_path and jax.process_index() == 0:
+            prom = args.metrics_path + ".prom"
+            try:
+                with open(prom, "w") as f:
+                    f.write(registry.exposition())
+            except OSError as e:
+                print(f"WARNING: cannot write metrics scrape file "
+                      f"{prom!r}: {e}", file=sys.stderr)
     return accuracy

@@ -5,7 +5,14 @@ serial-phase sum as a fraction of wall — the within-10% acceptance
 identity), a step-time histogram, and the slowest-K steps with their
 per-phase decomposition; ``--perfetto OUT.json`` additionally exports a
 schema-validated Chrome/Perfetto ``trace_event`` file for
-``ui.perfetto.dev``.
+``ui.perfetto.dev`` (request-scoped serve spans become connected flow
+chains there).
+
+``--requests`` switches to the request view: the slowest-K router-minted
+request ids with their per-hop breakdown (route → retry → queue_wait →
+the joined batch's engine stages).  ``--ledger CALIB.json`` joins the
+spill against a ``bench.py --calibrate_cost`` record into the
+predicted-vs-measured efficiency ledger (obs/ledger.py).
 
 Multi-host runs spill one file per host (``--trace_spill`` path plus
 ``.hostN`` suffixes); pass them all — the terminal report prints one
@@ -13,17 +20,44 @@ section per host (hosts' clocks are independent and each host's serial
 lanes tile its own wall), and the Perfetto export lays the hosts side
 by side (one process per host).
 
+Exit status: 0 on success; 2 on an unusable spill (missing file, no
+spans, or a mixed train+serve spill — each diagnosed in one line).
+
 Usage:
     python -m ddp_tpu.obs trace_spill.jsonl [more_spills...]
         [--perfetto trace.json] [--top 10] [--bins 12]
+        [--requests] [--ledger CALIB.json [--ledger_scale N]]
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 from typing import Optional
 
-from .export import format_report, read_spill
+from .export import (format_report, format_requests_report, read_spill,
+                     request_flows)
+from .ledger import build_ledger, format_ledger
+
+# Phase fingerprints: a train spill has the consumer loop's dispatch
+# phase; a serve spill has the batcher pipeline.  Both in one spill
+# means two unrelated runs were concatenated (or one path was reused),
+# and every wall identity in the report would be fiction.
+_TRAIN_MARKERS = frozenset(("dispatch",))
+_SERVE_MARKERS = frozenset(("queue_wait", "batch_form"))
+
+
+def _diagnose(spans: list, paths: list) -> Optional[str]:
+    """One-line reason this spill cannot be reported on, or None."""
+    if not spans:
+        return (f"no spans in {', '.join(paths)} — was the run "
+                "--obs_off, or killed before the first flush?")
+    phases = {s["phase"] for s in spans}
+    if (phases & _TRAIN_MARKERS) and (phases & _SERVE_MARKERS):
+        return ("mixed train+serve spill (has both 'dispatch' and "
+                f"{sorted(phases & _SERVE_MARKERS)}) — spills are "
+                "per-run; pass one run's files, not a concatenation")
+    return None
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -37,18 +71,51 @@ def main(argv: Optional[list] = None) -> int:
                    help="Also export a schema-validated Chrome/Perfetto "
                         "trace_event JSON (open in ui.perfetto.dev)")
     p.add_argument("--top", type=int, default=10,
-                   help="Slowest-K steps to list (default 10)")
+                   help="Slowest-K steps/requests to list (default 10)")
     p.add_argument("--bins", type=int, default=12,
                    help="Step-time histogram bins (default 12)")
+    p.add_argument("--requests", action="store_true",
+                   help="Report the slowest-K request flows (router req "
+                        "ids) instead of the phase/step tables")
+    p.add_argument("--ledger", default=None, metavar="CALIB.json",
+                   help="Join the spill against a bench.py "
+                        "--calibrate_cost record into the predicted-vs-"
+                        "measured efficiency ledger")
+    p.add_argument("--ledger_scale", type=float, default=1.0,
+                   help="Multiply predictions by this factor (set to the "
+                        "device count on a virtual CPU mesh, whose "
+                        "shards serialize; default 1)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="With --requests/--ledger: emit JSON instead of "
+                        "the terminal table")
     args = p.parse_args(argv)
-    spans = read_spill(args.spill)
-    if not spans:
-        print(f"no spans found in {args.spill} — was the run --obs_off, "
-              "or killed before the first flush?", file=sys.stderr)
-        return 1
     try:
-        print(format_report(spans, top=args.top, bins=args.bins,
-                            perfetto_out=args.perfetto))
+        spans = read_spill(args.spill)
+    except OSError as e:
+        print(f"cannot read spill: {e}", file=sys.stderr)
+        return 2
+    why = _diagnose(spans, args.spill)
+    if why is not None:
+        print(why, file=sys.stderr)
+        return 2
+    try:
+        if args.ledger is not None:
+            try:
+                with open(args.ledger) as f:
+                    calib = json.load(f)
+                ledger = build_ledger(spans, calib,
+                                      pred_scale=args.ledger_scale)
+            except (OSError, ValueError, json.JSONDecodeError) as e:
+                print(f"cannot build ledger: {e}", file=sys.stderr)
+                return 2
+            print(json.dumps(ledger) if args.as_json
+                  else format_ledger(ledger))
+        elif args.requests:
+            print(json.dumps(request_flows(spans)) if args.as_json
+                  else format_requests_report(spans, top=args.top))
+        else:
+            print(format_report(spans, top=args.top, bins=args.bins,
+                                perfetto_out=args.perfetto))
     except BrokenPipeError:  # `... | head` closed the pipe: not an error
         import os
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())

@@ -85,6 +85,7 @@ def read_spill(paths: Iterable[str]) -> List[dict]:
                     rec.setdefault("host", 0)
                     rec.setdefault("overlap", False)
                     rec.setdefault("step", None)
+                    rec.setdefault("req", None)
                     spans.append(rec)
     spans.sort(key=lambda r: r["start_s"])
     return spans
@@ -98,6 +99,13 @@ def to_trace_events(spans: List[dict]) -> dict:
     Timestamps are microseconds on the tracer's monotonic clock (hosts'
     clocks are independent; cross-host alignment is by step number in
     ``args``, not by wall time — same caveat as any multi-machine trace).
+
+    Request-scoped spans (a ``req`` id minted by the router at admission
+    and threaded through route/retry → queue_wait → the joined batch's
+    engine stages) additionally emit Perfetto *flow* events — one
+    ``s``/``t``.../``f`` chain per request id, each bound to its slice —
+    so one request renders as a single connected arrow path across
+    replica tracks, including a crash→retry hand-off between replicas.
     """
     hosts = sorted({int(s["host"]) for s in spans})
     phases = sorted({s["phase"] for s in spans}, key=_phase_rank)
@@ -109,17 +117,38 @@ def to_trace_events(spans: List[dict]) -> dict:
         for p in phases:
             events.append({"name": "thread_name", "ph": "M", "pid": h,
                            "tid": tid_of[p], "args": {"name": p}})
+    slice_of: Dict[int, dict] = {}
     for s in spans:
         args = {"overlap": bool(s["overlap"])}
         if s.get("step") is not None:
             args["step"] = int(s["step"])
-        events.append({
+        if s.get("req") is not None:
+            args["req"] = str(s["req"])
+        ev = {
             "name": s["phase"], "cat": "train", "ph": "X",
             "ts": round(float(s["start_s"]) * 1e6, 3),
             "dur": round(max(float(s["dur_s"]), 0.0) * 1e6, 3),
             "pid": int(s["host"]), "tid": tid_of[s["phase"]],
             "args": args,
-        })
+        }
+        slice_of[id(s)] = ev
+        events.append(ev)
+    # One flow chain per request: parent/child links between the slices
+    # the request passed through, in time order.  The flow event binds
+    # to its slice via matching pid/tid and a ts inside the slice.
+    for fid, (req, chain) in enumerate(
+            sorted(request_chains(spans).items()), start=1):
+        if len(chain) < 2:
+            continue  # a single-span request has nothing to connect
+        for j, s in enumerate(chain):
+            ev = slice_of[id(s)]
+            ph = "s" if j == 0 else ("f" if j == len(chain) - 1 else "t")
+            fev = {"name": f"req {req}", "cat": "request", "ph": ph,
+                   "id": fid, "pid": ev["pid"], "tid": ev["tid"],
+                   "ts": round(ev["ts"] + ev["dur"] / 2.0, 3)}
+            if ph == "f":
+                fev["bp"] = "e"  # bind the finish to the enclosing slice
+            events.append(fev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -142,8 +171,9 @@ def validate_trace_events(trace: dict) -> int:
         if not isinstance(ev.get("name"), str) or not ev["name"]:
             raise bad("needs a non-empty string 'name'")
         ph = ev.get("ph")
-        if ph not in ("X", "M"):
-            raise bad("has unsupported 'ph' (this exporter emits X/M only)")
+        if ph not in ("X", "M", "s", "t", "f"):
+            raise bad("has unsupported 'ph' (this exporter emits X/M "
+                      "slices and s/t/f flow events only)")
         if not isinstance(ev.get("pid"), int) or ev["pid"] < 0:
             raise bad("needs a non-negative integer 'pid'")
         if not isinstance(ev.get("tid"), int) or ev["tid"] < 0:
@@ -153,6 +183,12 @@ def validate_trace_events(trace: dict) -> int:
                 v = ev.get(key)
                 if not isinstance(v, (int, float)) or v < 0:
                     raise bad(f"needs a non-negative numeric {key!r}")
+        if ph in ("s", "t", "f"):
+            if not isinstance(ev.get("id"), (int, str)):
+                raise bad("flow events need an 'id' linking the chain")
+            v = ev.get("ts")
+            if not isinstance(v, (int, float)) or v < 0:
+                raise bad("needs a non-negative numeric 'ts'")
         if "args" in ev and not isinstance(ev["args"], dict):
             raise bad("'args' must be an object")
     return len(events)
@@ -165,6 +201,96 @@ def write_perfetto(spans: List[dict], out_path: str) -> int:
     with open(out_path, "w") as f:
         json.dump(trace, f)
     return n
+
+
+# -- request-scoped reconstruction ----------------------------------------
+
+# Spans a request passes through directly (they carry its ``req`` id)
+# versus the engine-thread stages it joins via the formed batch's
+# sequence number (``step`` on a serve spill).
+BATCH_PHASES = ("batch_form", "pad", "h2d", "forward", "d2h")
+
+
+def request_chains(spans: List[dict]) -> Dict[str, List[dict]]:
+    """``{req: [span, ...]}`` — every span a request passed through, in
+    time order: its own route/retry/queue_wait spans plus the engine
+    stages of each batch its ``queue_wait`` joined (matched on the
+    global batch sequence number, which is unique across replicas and
+    across checkpoint hot-swaps — serve/engine.py mints it from one
+    process-wide counter exactly so this join is unambiguous)."""
+    by_req: Dict[str, List[dict]] = {}
+    for s in spans:
+        if s.get("req") is not None:
+            by_req.setdefault(str(s["req"]), []).append(s)
+    if not by_req:
+        return {}
+    by_step: Dict[int, List[dict]] = {}
+    for s in spans:
+        if (s.get("req") is None and s.get("step") is not None
+                and s["phase"] in BATCH_PHASES):
+            by_step.setdefault(int(s["step"]), []).append(s)
+    chains: Dict[str, List[dict]] = {}
+    for req, own in by_req.items():
+        steps = sorted({int(s["step"]) for s in own
+                        if s.get("step") is not None
+                        and s["phase"] == "queue_wait"})
+        joined = list(own)
+        for st in steps:
+            joined.extend(by_step.get(st, []))
+        joined.sort(key=lambda r: (r["start_s"], _phase_rank(r["phase"])))
+        chains[req] = joined
+    return chains
+
+
+def request_flows(spans: List[dict]) -> Dict[str, dict]:
+    """Per-request hop breakdown: total latency, retry count, and the
+    batch step(s) it rode — the offline answer to "where did this p99
+    request go"."""
+    out: Dict[str, dict] = {}
+    for req, chain in request_chains(spans).items():
+        start = min(s["start_s"] for s in chain)
+        end = max(s["start_s"] + s["dur_s"] for s in chain)
+        out[req] = {
+            "hops": [{"phase": s["phase"],
+                      "start_s": round(float(s["start_s"]), 6),
+                      "dur_ms": float(s["dur_s"]) * 1e3,
+                      "step": s.get("step"),
+                      "host": int(s.get("host", 0))} for s in chain],
+            "total_ms": (end - start) * 1e3,
+            "retries": sum(1 for s in chain if s["phase"] == "retry"),
+            "batch_steps": sorted({
+                int(s["step"]) for s in chain
+                if s.get("step") is not None
+                and s["phase"] in BATCH_PHASES + ("queue_wait",)}),
+        }
+    return out
+
+
+def slowest_requests(spans: List[dict], k: int = 10
+                     ) -> List[Tuple[str, dict]]:
+    flows = request_flows(spans)
+    return sorted(flows.items(), key=lambda kv: kv[1]["total_ms"],
+                  reverse=True)[:max(k, 0)]
+
+
+def format_requests_report(spans: List[dict], top: int = 10) -> str:
+    """The ``python -m ddp_tpu.obs --requests`` table: slowest-K requests
+    with their per-hop breakdown."""
+    flows = request_flows(spans)
+    if not flows:
+        return ("no request-scoped spans in the spill (req ids are "
+                "minted by the serve router; train spills have none)")
+    lines = [f"{len(flows)} request(s); slowest {min(top, len(flows))}:"]
+    for req, f in slowest_requests(spans, top):
+        lines.append(
+            f"  {req}: {f['total_ms']:9.3f} ms total, "
+            f"{f['retries']} retries, batch step(s) "
+            f"{','.join(map(str, f['batch_steps'])) or '-'}")
+        lines.append("    " + " -> ".join(
+            f"{h['phase']}"
+            + (f"@{h['step']}" if h["step"] is not None else "")
+            + f" {h['dur_ms']:.3f}ms" for h in f["hops"]))
+    return "\n".join(lines)
 
 
 # -- terminal reports ------------------------------------------------------

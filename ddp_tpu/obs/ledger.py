@@ -1,0 +1,131 @@
+"""The efficiency ledger: measured spans joined against cost-model
+predictions, per phase.
+
+PR 8's calibrated cost model (``bench.py --calibrate_cost``) predicts
+ms/step per audited program from counted FLOPs/bytes and four fitted
+machine coefficients; the tracer measures what actually ran.  Until now
+nothing compared the two — this module is that join: each traced phase
+that corresponds to an audited program (``dispatch`` → the train step,
+``eval`` → the eval step, ``drift_audit`` → the SDC audit program,
+``forward`` → the serve forward) gets a predicted-vs-measured row with a
+gap percentage, and phases the model cannot price (host-side input work:
+``data_wait``/``host_augment``/``h2d``) are listed measured-only, so the
+table is honest about coverage.
+
+The ledger also records the spill's *serial-coverage fraction* (the
+non-overlap span sum over wall, obs/export.py's wall identity): a gap
+table computed from a spill whose serial lanes only tile 40% of wall is
+answering a different question than one at 95%, and the consumer
+(``tools/bench_trend.py``, BENCH_r11.json) should see that number next
+to the gaps.
+
+Mesh caveat, inherited from the calibration bench: the cost model prices
+ONE shard's body; a virtual CPU mesh (``--xla_force_host_platform_
+device_count``) serializes its shards, so measured ≈ n_dev × predicted
+there.  ``pred_scale`` (the CLI's ``--ledger_scale``, bench's device
+count) applies that known factor so the residual gap is signal, not
+mesh artifact.
+"""
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional
+
+from .export import phase_summary
+
+# Traced phase -> audited program-name prefix (analysis/programs.py
+# registry names are "<prefix>@<mesh>"). The preferred variant is the
+# plain data-parallel one; explicit calib keys win over prefix search.
+PHASE_PROGRAM_PREFIX = {
+    "dispatch": "train_step",
+    "eval": "eval_step",
+    "drift_audit": "drift_audit",
+    "forward": "serve_forward",
+}
+
+
+def _pick_program(prefix: str, predicted: Dict[str, float]
+                  ) -> Optional[str]:
+    """The calibration record's program for a phase: the plain ``@dp``
+    variant when present (accum/zero/tp variants answer narrower
+    questions), else the first match in sorted order."""
+    candidates = sorted(n for n in predicted
+                        if n == prefix or n.startswith(prefix + "@"))
+    for name in candidates:
+        tail = name.split("@", 1)[-1]
+        if tail.startswith("dp"):
+            return name
+    return candidates[0] if candidates else None
+
+
+def build_ledger(spans: List[dict], calib: dict, *,
+                 pred_scale: float = 1.0) -> dict:
+    """Join measured phase timings with calibrated predictions.
+
+    ``calib`` is the ``bench.py --calibrate_cost`` JSON record (needs
+    ``predicted_ms_per_step``; ``coefficients`` ride along for
+    provenance).  Returns ``{"rows": [...], "unpriced": [...],
+    "serial_coverage": f, "pred_scale": k, "coefficients": {...}}``
+    where each row carries ``phase, program, count, measured_ms
+    (median), predicted_ms, gap_pct`` — ``gap_pct`` positive when the
+    run was slower than the model's floor.
+    """
+    predicted = calib.get("predicted_ms_per_step") or {}
+    if not predicted:
+        raise ValueError(
+            "calibration record has no 'predicted_ms_per_step' — pass "
+            "the JSON emitted by bench.py --calibrate_cost")
+    by_phase: Dict[str, List[float]] = {}
+    for s in spans:
+        if not s.get("overlap"):
+            by_phase.setdefault(s["phase"], []).append(
+                float(s["dur_s"]) * 1e3)
+    rows: List[dict] = []
+    unpriced: List[dict] = []
+    for phase in sorted(by_phase):
+        durs = by_phase[phase]
+        measured = statistics.median(durs)
+        prefix = PHASE_PROGRAM_PREFIX.get(phase)
+        prog = _pick_program(prefix, predicted) if prefix else None
+        if prog is None:
+            unpriced.append({"phase": phase, "count": len(durs),
+                             "measured_ms": round(measured, 3)})
+            continue
+        pred = float(predicted[prog]) * float(pred_scale)
+        gap = ((measured - pred) / pred * 100.0) if pred > 0 else None
+        rows.append({
+            "phase": phase, "program": prog, "count": len(durs),
+            "measured_ms": round(measured, 3),
+            "predicted_ms": round(pred, 3),
+            "gap_pct": round(gap, 1) if gap is not None else None,
+        })
+    _, wall_s, critical_s = phase_summary(spans)
+    return {
+        "rows": rows,
+        "unpriced": unpriced,
+        "serial_coverage": round(critical_s / wall_s, 4) if wall_s else 0.0,
+        "pred_scale": float(pred_scale),
+        "coefficients": calib.get("coefficients", {}),
+    }
+
+
+def format_ledger(ledger: dict) -> str:
+    """The ``python -m ddp_tpu.obs --ledger`` terminal table."""
+    lines = [f"{'phase':<14} {'program':<22} {'count':>6} "
+             f"{'measured ms':>12} {'predicted ms':>13} {'gap':>8}"]
+    for r in ledger["rows"]:
+        gap = f"{r['gap_pct']:+.1f}%" if r["gap_pct"] is not None else "-"
+        lines.append(f"{r['phase']:<14} {r['program']:<22} "
+                     f"{r['count']:>6} {r['measured_ms']:>12.3f} "
+                     f"{r['predicted_ms']:>13.3f} {gap:>8}")
+    if not ledger["rows"]:
+        lines.append("  (no priceable phases in this spill)")
+    for r in ledger["unpriced"]:
+        lines.append(f"{r['phase']:<14} {'(unpriced)':<22} "
+                     f"{r['count']:>6} {r['measured_ms']:>12.3f} "
+                     f"{'-':>13} {'-':>8}")
+    lines.append(
+        f"serial coverage {ledger['serial_coverage'] * 100:.1f}% of wall; "
+        f"predictions scaled x{ledger['pred_scale']:g} "
+        "(virtual-mesh shard serialization)")
+    return "\n".join(lines)

@@ -19,23 +19,69 @@ from __future__ import annotations
 from collections import deque
 from typing import Dict, Optional
 
-# FLOP model for absolute-efficiency reporting (VERDICT r3 weak #5): VGG
-# trains at ~3.6 GFLOP/sample (fwd + dgrad + wgrad conv FLOPs; BASELINE.md
-# roofline, "1.84 TFLOP/step at batch 512").  MFU is reported against the
-# bf16-pass MXU peak MEASURED on the chip family actually running — the
-# right denominator for fp32 too, because the fp32 path's convs also run
-# as single-pass bf16-input/fp32-accum MXU passes (BASELINE.md).  On a
-# device kind with no measured peak, MFU is omitted rather than silently
-# computed against the wrong denominator (ADVICE r4).
-TRAIN_GFLOP_PER_SAMPLE = {"vgg": 3.6}
+# MFU is reported against the bf16-pass MXU peak MEASURED on the chip
+# family actually running — the right denominator for fp32 too, because
+# the fp32 path's convs also run as single-pass bf16-input/fp32-accum
+# MXU passes (BASELINE.md).  On a device kind with no measured peak, MFU
+# is omitted rather than silently computed against the wrong denominator
+# (ADVICE r4).
 PEAK_TFLOPS_BF16_PASS = {"TPU v5 lite": 197.0}  # measured, BASELINE.md
+
+# Per-sample train FLOPs, derived per model from the SAME cost model
+# BUDGETS.json gates (analysis/costmodel.py counts the fwd+bwd heavy
+# ops of the traced grad) — every registered model gets a live MFU from
+# one source of truth, instead of the old hand-maintained {"vgg": 3.6}
+# table that silently omitted MFU for deepnn/resnet18 runs.  None caches
+# a failed derivation so a broken model costs one attempt, not one per
+# emission.
+_GFLOP_CACHE: Dict[str, Optional[float]] = {}
+
+
+def train_gflop_per_sample(model_name: Optional[str]) -> Optional[float]:
+    """GFLOP per sample of one training step (forward + backward heavy
+    ops), counted by tracing ``grad(loss)`` abstractly at batch 1 through
+    :func:`~ddp_tpu.analysis.costmodel.cost_of_jaxpr`.  Cached per model;
+    None when the model is unknown or untraceable."""
+    if not model_name:
+        return None
+    if model_name in _GFLOP_CACHE:
+        return _GFLOP_CACHE[model_name]
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ..analysis.costmodel import cost_of_jaxpr
+        from ..models import get_model
+        model = get_model(model_name)
+        params, stats = jax.eval_shape(model.init, jax.random.key(0))
+
+        def _sds(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.result_type(x)), tree)
+
+        def loss(p, s, x, y, rng):
+            logits, _ = model.apply(p, s, x, train=True, rng=rng)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], 1))
+
+        closed = jax.make_jaxpr(jax.grad(loss))(
+            _sds(params), _sds(stats),
+            jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            _sds(jax.random.key(0)))
+        gflop = cost_of_jaxpr(closed.jaxpr).flops / 1e9
+    except Exception:  # no MFU beats a wrong or crashing one
+        gflop = None
+    _GFLOP_CACHE[model_name] = gflop
+    return gflop
 
 
 def model_mfu(samples_per_sec_per_chip: float, model: Optional[str],
               device_kind: Optional[str]) -> Optional[float]:
     """MFU for a measured per-chip rate, or None when either the model
-    has no FLOP model or the device kind has no measured peak."""
-    gflop = TRAIN_GFLOP_PER_SAMPLE.get(model or "")
+    cannot be FLOP-counted or the device kind has no measured peak."""
+    gflop = train_gflop_per_sample(model)
     peak = PEAK_TFLOPS_BF16_PASS.get(device_kind or "")
     if gflop is None or peak is None:
         return None

@@ -68,11 +68,13 @@ class NullTracer:
     enabled = False
 
     def span(self, phase: str, step: Optional[int] = None,
-             overlap: bool = False) -> _NullSpan:
+             overlap: bool = False,
+             req: Optional[str] = None) -> _NullSpan:
         return _NULL_SPAN
 
     def add_span(self, phase: str, start_monotonic: float, dur_s: float,
-                 step: Optional[int] = None, overlap: bool = False) -> None:
+                 step: Optional[int] = None, overlap: bool = False,
+                 req: Optional[str] = None) -> None:
         pass
 
     def now(self) -> float:
@@ -98,14 +100,16 @@ class NullTracer:
 
 class _Span:
     """One in-flight span; records itself on clean ``__exit__`` only."""
-    __slots__ = ("_tracer", "phase", "step", "overlap", "_start")
+    __slots__ = ("_tracer", "phase", "step", "overlap", "req", "_start")
 
     def __init__(self, tracer: "SpanTracer", phase: str,
-                 step: Optional[int], overlap: bool):
+                 step: Optional[int], overlap: bool,
+                 req: Optional[str] = None):
         self._tracer = tracer
         self.phase = phase
         self.step = step
         self.overlap = overlap
+        self.req = req
 
     def __enter__(self) -> "_Span":
         self._start = time.monotonic()
@@ -115,7 +119,7 @@ class _Span:
         if exc_type is None:  # an aborted body is not a completed phase
             end = time.monotonic()
             self._tracer._record(self.phase, self.step, self._start,
-                                 end - self._start, self.overlap)
+                                 end - self._start, self.overlap, self.req)
         return False
 
 
@@ -152,29 +156,34 @@ class SpanTracer:
     # -- recording ---------------------------------------------------------
 
     def span(self, phase: str, step: Optional[int] = None,
-             overlap: bool = False) -> _Span:
-        return _Span(self, phase, step, overlap)
+             overlap: bool = False, req: Optional[str] = None) -> _Span:
+        return _Span(self, phase, step, overlap, req)
 
     def add_span(self, phase: str, start_monotonic: float, dur_s: float,
-                 step: Optional[int] = None, overlap: bool = False) -> None:
+                 step: Optional[int] = None, overlap: bool = False,
+                 req: Optional[str] = None) -> None:
         """Record a span measured by the caller (``start_monotonic`` on
         the ``time.monotonic`` clock) — for sites that only know AFTER
         timing whether the interval was a real phase occurrence (e.g. the
         prefetch consumer's queue get, which may return the end-of-stream
         sentinel rather than a batch)."""
-        self._record(phase, step, start_monotonic, dur_s, overlap)
+        self._record(phase, step, start_monotonic, dur_s, overlap, req)
 
     def _record(self, phase: str, step: Optional[int], start: float,
-                dur: float, overlap: bool) -> None:
-        rec = (phase, step, start - self._t0, dur, overlap)
+                dur: float, overlap: bool,
+                req: Optional[str] = None) -> None:
+        rec = (phase, step, start - self._t0, dur, overlap, req)
         # Serialize OUTSIDE the lock: json.dumps is pure CPU on local
         # data, and holding the one shared lock through it would make
         # every producer thread contend on exactly the work being timed.
-        line = (json.dumps({
+        body = {
             "phase": phase, "step": step,
             "start_s": round(rec[2], 6), "dur_s": round(dur, 6),
             "overlap": overlap, "host": self.host,
-        }) + "\n") if self._f is not None else None
+        }
+        if req is not None:  # request-scoped spans only — lines stay lean
+            body["req"] = req
+        line = (json.dumps(body) + "\n") if self._f is not None else None
         with self._lock:
             self._ring.append(rec)
             self._last[phase] = rec
@@ -206,9 +215,9 @@ class SpanTracer:
 
     @staticmethod
     def _as_dict(rec: tuple) -> dict:
-        phase, step, start, dur, overlap = rec
+        phase, step, start, dur, overlap, req = rec
         return {"phase": phase, "step": step, "start_s": start,
-                "dur_s": dur, "overlap": overlap}
+                "dur_s": dur, "overlap": overlap, "req": req}
 
     def spans_since(self, t: float) -> List[dict]:
         """Completed spans whose start is at or after tracer-time ``t``

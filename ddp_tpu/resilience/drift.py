@@ -133,7 +133,7 @@ class DriftAuditor:
     """
 
     def __init__(self, mesh, params_like, *, every: int,
-                 action: str = "abort"):
+                 action: str = "abort", registry=None):
         if action not in DRIFT_ACTIONS:
             raise ValueError(
                 f"drift_action must be one of {DRIFT_ACTIONS}, got "
@@ -144,6 +144,17 @@ class DriftAuditor:
         self._fn = make_drift_audit(mesh)
         self.last_audit_step: int = -1  # watchdog stall-context surface
         self.detections = 0
+        self.audits = 0
+        if registry is not None:
+            # Function-backed: this object stays the source of truth.
+            registry.counter(
+                "ddp_drift_audits_total",
+                "Cross-replica SDC audits run").set_function(
+                    lambda: float(self.audits))
+            registry.counter(
+                "ddp_drift_detections_total",
+                "Audits that found cross-replica parameter drift"
+            ).set_function(lambda: float(self.detections))
 
     def due(self, step: int) -> bool:
         return self.every > 0 and step > 0 and step % self.every == 0
@@ -154,6 +165,7 @@ class DriftAuditor:
         divergence.  ``guard`` (the trainer's StepHealthGuard) supplies
         the shared restore budget for ``action='restore'``."""
         self.last_audit_step = int(step)
+        self.audits += 1
         counts, fps = self._fn(params)
         counts = np.asarray(jax.device_get(counts))
         if not counts.any():

@@ -278,7 +278,7 @@ def crash_replica_at_request_n(replica, n: int) -> None:
     lock = threading.Lock()
     count = [0]
 
-    def wrapped(images, timeout=None):
+    def wrapped(images, timeout=None, req=None):
         with lock:
             count[0] += 1
             c = count[0]
@@ -288,7 +288,7 @@ def crash_replica_at_request_n(replica, n: int) -> None:
                       f"request {c}", file=sys.stderr)
                 sys.stderr.flush()
             replica.crashed = True
-        return orig(images, timeout=timeout)
+        return orig(images, timeout=timeout, req=req)
 
     replica.submit = wrapped
 
@@ -300,9 +300,9 @@ def slow_forward_ms(replica, ms: float) -> None:
     orig = replica.submit
     delay_s = float(ms) / 1e3
 
-    def wrapped(images, timeout=None):
+    def wrapped(images, timeout=None, req=None):
         time.sleep(delay_s)
-        return orig(images, timeout=timeout)
+        return orig(images, timeout=timeout, req=req)
 
     replica.submit = wrapped
 

@@ -99,7 +99,8 @@ class RestoreFromLastGood(Exception):
 class StepHealthGuard:
     def __init__(self, policy: str = "abort", max_restores: int = 8, *,
                  window: int = 64, spike_factor: float = 0.0,
-                 spike_action: str = "rollback", metrics=None):
+                 spike_action: str = "rollback", metrics=None,
+                 registry=None):
         if policy not in POLICIES:
             raise ValueError(
                 f"on_nan policy must be one of {POLICIES}, got {policy!r}")
@@ -119,6 +120,14 @@ class StepHealthGuard:
         self.metrics = metrics
         self.last_decision = "none"  # watchdog stall-context surface
         self.decisions: Counter = Counter()
+        # Mirror every decision into the run's metrics registry as a
+        # labelled family; ``decisions`` stays the in-process truth
+        # (test-pinned API), the registry is the scrape surface.
+        self._reg_decisions = (
+            registry.counter("ddp_guard_decisions_total",
+                             "Step-health guard decisions by kind",
+                             ("decision",))
+            if registry is not None else None)
         self.lr_scale = 1.0
         # Trainer hook: called with the new cumulative LR scale when the
         # lr_backoff action fires (the trainer rebuilds its jitted step
@@ -132,6 +141,8 @@ class StepHealthGuard:
 
     def _decide(self, decision: str, *, step: int, **fields) -> None:
         self.decisions[decision] += 1
+        if self._reg_decisions is not None:
+            self._reg_decisions.labels(decision=decision).inc()
         self.last_decision = f"{decision}@step={int(step)}"
         if self.metrics is not None:
             self.metrics.log_event("guard_decision", decision=decision,

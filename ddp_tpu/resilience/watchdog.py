@@ -35,7 +35,8 @@ class Watchdog:
     def __init__(self, timeout_s: float, *, tag: str = "train",
                  on_expire: Optional[Callable[[], None]] = None,
                  context: Optional[Callable[[], str]] = None,
-                 exit_status: int = WATCHDOG_EXIT_STATUS):
+                 exit_status: int = WATCHDOG_EXIT_STATUS,
+                 registry=None):
         if timeout_s <= 0:
             raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
         self.timeout_s = float(timeout_s)
@@ -55,6 +56,22 @@ class Watchdog:
         # nothing but contention.
         # analysis: unlocked-ok(atomic float; staleness bounded by poll)
         self._last = time.monotonic()
+        # Same single-writer argument as _last: beat() is the trainer
+        # thread only, expirations the watchdog thread only (and the
+        # process exits right after).
+        # analysis: unlocked-ok(single-writer int; scrape-only readers)
+        self.beats = 0
+        # analysis: unlocked-ok(single-writer int; scrape-only readers)
+        self.expirations = 0
+        if registry is not None:
+            registry.counter(
+                "ddp_watchdog_beats_total",
+                "Progress heartbeats received").set_function(
+                    lambda: float(self.beats))
+            registry.counter(
+                "ddp_watchdog_expirations_total",
+                "Watchdog expiries (stall -> hard exit)").set_function(
+                    lambda: float(self.expirations))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._exit = os._exit  # monkeypatch seam for in-process tests
@@ -62,6 +79,7 @@ class Watchdog:
     def beat(self) -> None:
         """Record progress; cheap enough for per-step calls."""
         self._last = time.monotonic()
+        self.beats += 1
 
     def start(self) -> "Watchdog":
         if self._thread is not None:
@@ -94,6 +112,7 @@ class Watchdog:
                 return
 
     def _expire(self, idle: float) -> None:
+        self.expirations += 1
         print(f"WATCHDOG [{self.tag}]: no progress for {idle:.1f}s "
               f"(limit {self.timeout_s:.1f}s); aborting the coordination "
               f"service and hard-exiting {self.exit_status} so peers fail "

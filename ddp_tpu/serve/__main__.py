@@ -2,7 +2,8 @@
 
 Loads the newest verifiable checkpoint (the trainer's own lineage walk),
 AOT-compiles one eval forward per padded batch bucket, and serves
-``/predict`` / ``/healthz`` / ``/stats`` over a stdlib threaded HTTP
+``/predict`` / ``/healthz`` / ``/stats`` / ``/metrics`` (Prometheus
+text exposition) over a stdlib threaded HTTP
 server fronted by the dynamic micro-batcher.  SIGTERM/SIGINT drain
 gracefully through the resilience preemption guard: admission stops
 (503 + draining healthz), accepted requests finish, the span spill is
@@ -101,6 +102,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
 
+    from ..obs.registry import MetricsRegistry
     from ..obs.tracer import NullTracer, SpanTracer, set_tracer
     from ..parallel.mesh import make_mesh
     from ..resilience.faults import install_serve_faults
@@ -116,6 +118,7 @@ def main(argv: Optional[list] = None) -> int:
         tracer = SpanTracer(spill_path=args.trace_spill or None,
                             ring=65536, host=0)
     mesh = make_mesh(args.num_devices)
+    registry = MetricsRegistry()  # one /metrics surface per process
     buckets = [int(b) for b in args.buckets.split(",") if b]
     compute_dtype = jnp.bfloat16 if args.bf16 else None
     try:
@@ -130,7 +133,8 @@ def main(argv: Optional[list] = None) -> int:
                 n_replicas=args.fleet, buckets=buckets,
                 compute_dtype=compute_dtype, max_batch=args.max_batch,
                 max_wait_ms=args.max_wait_ms,
-                queue_depth=args.queue_depth, tracer=tracer)
+                queue_depth=args.queue_depth, tracer=tracer,
+                registry=registry)
             install_serve_faults(fleet)
             fleet.start(poll_s=args.swap_poll_s)
             print(f"warmed {args.fleet} replica(s) in "
@@ -142,7 +146,8 @@ def main(argv: Optional[list] = None) -> int:
         else:
             engine = ServeEngine.from_checkpoint(
                 args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
-                compute_dtype=compute_dtype, tracer=tracer)
+                compute_dtype=compute_dtype, tracer=tracer,
+                registry=registry)
             t0 = time.monotonic()
             compiled = engine.warm()
             print(f"compiled {compiled} bucket executable(s) "
@@ -153,7 +158,8 @@ def main(argv: Optional[list] = None) -> int:
             batcher = DynamicBatcher(engine, max_batch=args.max_batch,
                                      max_wait_ms=args.max_wait_ms,
                                      queue_depth=args.queue_depth,
-                                     tracer=tracer).start()
+                                     tracer=tracer,
+                                     registry=registry).start()
             httpd = ServeHTTPServer((args.host, args.port), engine, batcher)
         listener = threading.Thread(target=httpd.serve_forever,
                                     daemon=True, name="serve-http")
@@ -168,8 +174,8 @@ def main(argv: Optional[list] = None) -> int:
         what = (f"{args.model} fleet of {args.fleet}" if fleet is not None
                 else args.model)
         print(f"serving {what} on http://{host}:{port} "
-              "(/predict /healthz /stats); SIGTERM drains gracefully",
-              flush=True)
+              "(/predict /healthz /stats /metrics); SIGTERM drains "
+              "gracefully", flush=True)
         try:
             while guard is None or not guard.noticed():
                 time.sleep(0.2)

@@ -30,7 +30,15 @@ Telemetry: each request's ``queue_wait`` (enqueue -> batch formation) is
 recorded as an ``overlap=True`` span (it runs concurrently with the
 engine thread's serial pad/h2d/forward/d2h pipeline), and each formed
 batch records a ``batch_form`` span keyed by the same batch sequence
-number the engine's spans use.
+number the engine's spans use — the batcher CLAIMS that number
+(``engine.claim_batch_seq``, process-unique across replicas and
+hot-swaps) at formation and passes it to ``forward(seq=...)``, and each
+``queue_wait`` span carries the request's router-minted ``req`` id, so
+the offline tooling joins request -> batch -> engine stages
+unambiguously.  Counters live in the shared metrics registry
+(``ddp_batcher_*``; legacy ``stats()`` names are read-only views), plus
+one ``ddp_batcher_request_latency_ms`` histogram of served-request
+end-to-end latency.
 """
 from __future__ import annotations
 
@@ -43,8 +51,9 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs.registry import MetricsRegistry
 from ..obs.tracer import get_tracer
-from .engine import RequestTooLarge, ServeError
+from .engine import RequestTooLarge, ServeError, claim_batch_seq
 
 
 class QueueFull(ServeError):
@@ -59,10 +68,12 @@ class Draining(ServeError):
 
 class _Request:
     __slots__ = ("images", "n", "t_submit", "event", "logits", "error",
-                 "abandoned")
+                 "abandoned", "req_id")
 
-    def __init__(self, images: np.ndarray):
+    def __init__(self, images: np.ndarray,
+                 req_id: Optional[str] = None):
         self.images = images
+        self.req_id = req_id  # router-minted request id (span flow key)
         self.n = images.shape[0]
         self.t_submit = time.monotonic()
         self.event = threading.Event()
@@ -89,7 +100,7 @@ def percentiles(values: List[float], points=(50, 90, 99)) -> dict:
 class DynamicBatcher:
     def __init__(self, engine, *, max_batch: Optional[int] = None,
                  max_wait_ms: float = 5.0, queue_depth: int = 256,
-                 tracer=None):
+                 tracer=None, registry=None, metric_labels=None):
         self.engine = engine
         self.max_batch = engine.max_rows if max_batch is None \
             else min(int(max_batch), engine.max_rows)
@@ -112,20 +123,75 @@ class DynamicBatcher:
         self._latency_ms: collections.deque = collections.deque(maxlen=4096)
         # analysis: shared-under(_stats_lock)
         self._batch_rows: collections.deque = collections.deque(maxlen=4096)
-        self.submitted = 0          # analysis: shared-under(_stats_lock)
-        self.served_requests = 0    # analysis: shared-under(_stats_lock)
-        self.shed_queue_full = 0    # analysis: shared-under(_stats_lock)
-        self.rejected_oversize = 0  # analysis: shared-under(_stats_lock)
-        self.timed_out = 0          # analysis: shared-under(_stats_lock)
-        self.batches = 0            # analysis: shared-under(_stats_lock)
+        # Counters live in the metrics registry (internally locked;
+        # private registry by default — the fleet passes its shared one
+        # with a replica label); the deques above stay under _stats_lock
+        # for the stats() percentile snapshot.
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        labels = dict(metric_labels or {})
+        labelnames = tuple(sorted(labels))
+        reg = self.registry
+        self._c_submitted = reg.counter(
+            "ddp_batcher_submitted_total",
+            "Requests accepted for batching", labelnames).labels(**labels)
+        self._c_served = reg.counter(
+            "ddp_batcher_served_total",
+            "Requests served with logits", labelnames).labels(**labels)
+        self._c_shed_queue_full = reg.counter(
+            "ddp_batcher_shed_queue_full_total",
+            "Requests shed at admission (queue at capacity)",
+            labelnames).labels(**labels)
+        self._c_rejected_oversize = reg.counter(
+            "ddp_batcher_rejected_oversize_total",
+            "Requests rejected as larger than the largest bucket",
+            labelnames).labels(**labels)
+        self._c_timed_out = reg.counter(
+            "ddp_batcher_timed_out_total",
+            "Requests whose caller gave up before service",
+            labelnames).labels(**labels)
+        self._c_batches = reg.counter(
+            "ddp_batcher_batches_total",
+            "Batches formed and forwarded", labelnames).labels(**labels)
+        self._h_latency = reg.histogram(
+            "ddp_batcher_request_latency_ms",
+            "Served-request latency, submit to logits (ms)",
+            labelnames).labels(**labels)
+
+    # Legacy counter names: read-only views of the registry children.
+    @property
+    def submitted(self) -> int:
+        return int(self._c_submitted.value)
+
+    @property
+    def served_requests(self) -> int:
+        return int(self._c_served.value)
+
+    @property
+    def shed_queue_full(self) -> int:
+        return int(self._c_shed_queue_full.value)
+
+    @property
+    def rejected_oversize(self) -> int:
+        return int(self._c_rejected_oversize.value)
+
+    @property
+    def timed_out(self) -> int:
+        return int(self._c_timed_out.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._c_batches.value)
 
     # -- caller side -------------------------------------------------------
 
     def submit(self, images: np.ndarray,
-               timeout: Optional[float] = None) -> np.ndarray:
+               timeout: Optional[float] = None,
+               req_id: Optional[str] = None) -> np.ndarray:
         """Block until ``images``' logits are ready (or raise).  Thread-safe
         — this is the one entry point every HTTP handler thread and load
-        generator worker calls concurrently."""
+        generator worker calls concurrently.  ``req_id`` (router-minted)
+        rides into the request's spans for flow reconstruction."""
         images = np.asarray(images)
         # Validate at ADMISSION: a malformed request must fail alone, not
         # poison the innocent requests it would have been co-batched with.
@@ -142,21 +208,18 @@ class DynamicBatcher:
         if n == 0:
             raise ValueError("empty request (0 rows)")
         if n > self.engine.max_rows:
-            with self._stats_lock:
-                self.rejected_oversize += 1
+            self._c_rejected_oversize.inc()
             raise RequestTooLarge(
                 f"{n} rows exceed the largest padded batch bucket "
                 f"{self.engine.max_rows}; split the request")
         if self._draining.is_set():
             raise Draining("server is draining; no new requests accepted")
-        req = _Request(images)
-        with self._stats_lock:
-            self.submitted += 1
+        req = _Request(images, req_id=req_id)
+        self._c_submitted.inc()
         try:
             self._q.put_nowait(req)
         except queue.Full:
-            with self._stats_lock:
-                self.shed_queue_full += 1
+            self._c_shed_queue_full.inc()
             raise QueueFull(
                 f"admission queue at capacity ({self._q.maxsize} "
                 "requests); retry after backoff") from None
@@ -169,17 +232,17 @@ class DynamicBatcher:
             self._flush_queue()
         if not req.event.wait(timeout):
             req.abandoned = True  # reclaim the forward capacity
-            with self._stats_lock:
-                self.timed_out += 1
+            self._c_timed_out.inc()
             raise TimeoutError(
                 f"request not served within {timeout}s (queue depth "
                 f"{self._q.qsize()})")
         if req.error is not None:
             raise req.error
+        lat_ms = (time.monotonic() - req.t_submit) * 1e3
         with self._stats_lock:
-            self._latency_ms.append(
-                (time.monotonic() - req.t_submit) * 1e3)
-            self.served_requests += 1
+            self._latency_ms.append(lat_ms)
+        self._c_served.inc()
+        self._h_latency.observe(lat_ms)
         return req.logits
 
     # -- engine thread -----------------------------------------------------
@@ -251,19 +314,23 @@ class DynamicBatcher:
         batch = [r for r in batch if not r.abandoned]
         if not batch:
             return  # every caller gave up: don't burn the forward
-        seq = self.engine._seq  # the span step key forward() will use
+        # Claim the process-unique batch sequence HERE so queue_wait/
+        # batch_form and the engine's pad/h2d/forward/d2h spans share one
+        # key even across a hot-swap replacing the engine mid-run.
+        seq = claim_batch_seq()
         t_form = time.monotonic()
         for r in batch:
             # Per-request admission->formation wait; overlap=True — these
             # intervals run concurrently with the engine thread's serial
             # pipeline and would double-count a wall-time identity.
             self.tracer.add_span("queue_wait", r.t_submit,
-                                 t_form - r.t_submit, step=seq, overlap=True)
+                                 t_form - r.t_submit, step=seq, overlap=True,
+                                 req=r.req_id)
         try:
             with self.tracer.span("batch_form", step=seq):
                 images = (batch[0].images if len(batch) == 1
                           else np.concatenate([r.images for r in batch]))
-            logits = self.engine.forward(images)
+            logits = self.engine.forward(images, seq=seq)
         except BaseException as e:
             for r in batch:
                 r.error = e
@@ -275,8 +342,8 @@ class DynamicBatcher:
             off += r.n
             r.event.set()
         with self._stats_lock:
-            self.batches += 1
             self._batch_rows.append(off)
+        self._c_batches.inc()
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -42,9 +42,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.registry import MetricsRegistry
 from ..obs.tracer import get_tracer
 from ..parallel.mesh import batch_sharding, replicated_sharding
 from ..train.step import make_eval_forward
+
+# Batch sequence numbers are PROCESS-GLOBAL, not per-engine: a fleet
+# runs several engines at once and a checkpoint hot-swap replaces an
+# engine mid-run, so a per-engine counter would reuse step keys across
+# replicas/generations and make the span-spill request->batch join
+# (obs/export.py request_chains) ambiguous.  The batcher claims the seq
+# at batch formation and passes it to forward(); a direct forward() call
+# claims its own.
+_SEQ_LOCK = threading.Lock()
+_NEXT_SEQ = 0
+
+
+def claim_batch_seq() -> int:
+    """The next process-unique batch sequence number (span step key)."""
+    global _NEXT_SEQ
+    with _SEQ_LOCK:
+        seq = _NEXT_SEQ
+        _NEXT_SEQ += 1
+        return seq
 
 
 class ServeError(Exception):
@@ -84,13 +104,38 @@ class ServeEngine:
 
     def __init__(self, model, params, batch_stats, mesh, *,
                  buckets: Sequence[int] = (1, 8, 32, 128),
-                 compute_dtype=None, tracer=None):
+                 compute_dtype=None, tracer=None, registry=None,
+                 metric_labels=None):
         self.model = model
         self.mesh = mesh
         self.compute_dtype = compute_dtype
         self.buckets = resolve_buckets(buckets, mesh.devices.size)
         self.max_rows = self.buckets[-1]
         self.trace_count = 0  # analysis: shared-under(_stats_lock)
+        # Registry instruments: private registry by default (instance
+        # isolation); the fleet passes its shared one with a replica
+        # label so /metrics rolls every engine up side by side.  The
+        # legacy stats() fields stay per-engine (a hot-swap starts a
+        # fresh engine); the registry children are cumulative per label,
+        # which is exactly Prometheus counter semantics across swaps.
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        labels = dict(metric_labels or {})
+        labelnames = tuple(sorted(labels))
+        self._c_rows = self.registry.counter(
+            "ddp_engine_rows_served_total",
+            "Valid rows returned by forward()", labelnames).labels(**labels)
+        self._c_forwards = self.registry.counter(
+            "ddp_engine_forwards_total",
+            "Compiled forwards executed, by padded bucket",
+            labelnames + ("bucket",))
+        self._fwd_children = {
+            b: self._c_forwards.labels(bucket=str(b), **labels)
+            for b in self.buckets}
+        self._g_compiled = self.registry.gauge(
+            "ddp_engine_compiled_executables",
+            "Executables compiled so far (the compile-bound contract)",
+            labelnames).labels(**labels)
 
         def _on_trace() -> None:
             # Tracing happens inside warm()/forward() calls while /stats
@@ -99,6 +144,7 @@ class ServeEngine:
             # no ordering risk with the pipeline _lock).
             with self._stats_lock:
                 self.trace_count += 1
+            self._g_compiled.inc()
 
         self._fwd = make_eval_forward(model, mesh, compute_dtype,
                                       on_trace=_on_trace)
@@ -114,8 +160,9 @@ class ServeEngine:
         # must not block behind an in-flight forward (hundreds of ms at
         # load — a health probe that flaps under load is worse than none).
         self._stats_lock = threading.Lock()
-        # forward-batch sequence number (span step key)
-        self._seq = 0  # analysis: shared-under(_stats_lock)
+        # Batches this engine instance ran (the span step key is the
+        # process-global claim_batch_seq(), not this).
+        self._forward_batches = 0  # analysis: shared-under(_stats_lock)
         # analysis: shared-under(_stats_lock)
         self._per_bucket: Dict[int, int] = {b: 0 for b in self.buckets}
         self.rows_served = 0  # analysis: shared-under(_stats_lock)
@@ -132,7 +179,8 @@ class ServeEngine:
     @classmethod
     def from_checkpoint(cls, snapshot_path: str, model_name: str, *, mesh,
                         buckets: Sequence[int] = (1, 8, 32, 128),
-                        compute_dtype=None, tracer=None) -> "ServeEngine":
+                        compute_dtype=None, tracer=None,
+                        registry=None) -> "ServeEngine":
         """Load the newest *verifiable* checkpoint under ``snapshot_path``
         (a head path or a directory) through the SAME lineage walk the
         trainer's ``--resume`` uses — ``resilience.lineage
@@ -163,7 +211,7 @@ class ServeEngine:
         ckpt, used = loaded
         engine = cls(get_model(model_name), ckpt.params, ckpt.batch_stats,
                      mesh, buckets=buckets, compute_dtype=compute_dtype,
-                     tracer=tracer)
+                     tracer=tracer, registry=registry)
         engine.checkpoint_file = used
         engine.checkpoint_epoch = int(ckpt.epoch)
         engine.checkpoint_step = int(ckpt.step)
@@ -196,11 +244,16 @@ class ServeEngine:
             f"{self.max_rows}; split the request or restart the server "
             "with a larger --buckets set")
 
-    def forward(self, images: np.ndarray) -> np.ndarray:
+    def forward(self, images: np.ndarray,
+                seq: Optional[int] = None) -> np.ndarray:
         """Logits for ``images`` (uint8 ``[n, 32, 32, 3]`` — the loaders'
         wire format; one dtype keeps the executable set at one program
         per bucket).  Pads to the bucket, runs the compiled forward,
-        returns the valid ``[n, num_classes]`` float32 rows."""
+        returns the valid ``[n, num_classes]`` float32 rows.
+
+        ``seq`` is the batch sequence key for this forward's spans —
+        the batcher claims it at batch formation (so its queue_wait/
+        batch_form spans share it); a direct call claims its own."""
         images = np.asarray(images)
         if images.ndim != 4 or images.shape[1:] != self.input_shape:
             raise ValueError(
@@ -214,10 +267,11 @@ class ServeEngine:
         if n == 0:
             return np.zeros((0, 0), np.float32)
         bucket = self.bucket_for(n)
+        if seq is None:
+            seq = claim_batch_seq()
         with self._lock:
             with self._stats_lock:
-                seq = self._seq
-                self._seq += 1
+                self._forward_batches += 1
             tracer = self.tracer
             with tracer.span("pad", step=seq):
                 if n < bucket:
@@ -235,6 +289,8 @@ class ServeEngine:
             with self._stats_lock:
                 self._per_bucket[bucket] += 1
                 self.rows_served += n
+            self._fwd_children[bucket].inc()
+            self._c_rows.inc(n)
         return logits
 
     def predict(self, images: np.ndarray) -> np.ndarray:
@@ -249,7 +305,7 @@ class ServeEngine:
             return {
                 "buckets": list(self.buckets),
                 "compiled_executables": self.trace_count,
-                "forward_batches": self._seq,
+                "forward_batches": self._forward_batches,
                 "forward_batches_per_bucket": {
                     str(b): c for b, c in self._per_bucket.items()},
                 "rows_served": self.rows_served,

@@ -34,7 +34,12 @@ zero-downtime path that changes it:
 
 Each replica carries its own engine (own compiled functions, own
 replicated param copy): replicas fail, swap, and serve independently,
-which is the point of a fleet.  On one shared host this costs N param
+which is the point of a fleet.  One :class:`~ddp_tpu.obs.registry.
+MetricsRegistry` is shared fleet-wide — the router's counters plus each
+replica's engine/batcher series under a ``replica`` label, with
+fleet-rollup gauges (``ddp_fleet_healthy_replicas``,
+``ddp_fleet_swap_commits_total``) — so one ``/metrics`` scrape reads
+the whole fleet.  On one shared host this costs N param
 copies — the price of blast-radius isolation, recorded honestly in
 BENCH_r09 rather than hidden behind shared state.
 """
@@ -52,6 +57,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs.registry import MetricsRegistry
 from ..obs.tracer import get_tracer
 from .batcher import Draining, DynamicBatcher, QueueFull
 from .engine import RequestTooLarge, ServeEngine
@@ -87,7 +93,8 @@ class LocalReplica:
         with self._pair_lock:
             return self.engine, self.batcher
 
-    def submit(self, images, timeout: Optional[float] = None):
+    def submit(self, images, timeout: Optional[float] = None,
+               req: Optional[str] = None):
         if self.crashed:
             raise ReplicaCrashed(
                 f"replica {self.replica_id} is down (crash fault latched)")
@@ -95,7 +102,7 @@ class LocalReplica:
         # The batcher reference is pinned BEFORE submit: a swap landing
         # mid-call drains this (old) batcher, which still serves every
         # request it accepted — the consistent-snapshot guarantee.
-        return batcher.submit(images, timeout=timeout)
+        return batcher.submit(images, timeout=timeout, req_id=req)
 
     def queue_depth(self) -> int:
         _, batcher = self._pair()
@@ -166,15 +173,18 @@ class HTTPReplica:
         # not cost an HTTP round trip per routing decision.
         self._last_depth = 0    # analysis: shared-under(_lock)
 
-    def submit(self, images, timeout: Optional[float] = None):
+    def submit(self, images, timeout: Optional[float] = None,
+               req: Optional[str] = None):
         body = json.dumps(
             {"instances": np.asarray(images).tolist()}).encode()
-        req = urllib.request.Request(
-            self.base_url + "/predict", data=body,
-            headers={"Content-Type": "application/json"})
+        headers = {"Content-Type": "application/json"}
+        if req is not None:
+            headers["X-Request-Id"] = req
+        http_req = urllib.request.Request(
+            self.base_url + "/predict", data=body, headers=headers)
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout if timeout is not None
+                    http_req, timeout=timeout if timeout is not None
                     else 30.0) as r:
                 out = json.load(r)
         except urllib.error.HTTPError as e:
@@ -260,7 +270,7 @@ class ServeFleet:
                  compute_dtype=None, max_batch: Optional[int] = None,
                  max_wait_ms: float = 5.0, queue_depth: int = 256,
                  drain_timeout_s: float = 30.0, tracer=None,
-                 router_kwargs: Optional[dict] = None):
+                 router_kwargs: Optional[dict] = None, registry=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
         self.snapshot_path = snapshot_path
@@ -273,6 +283,11 @@ class ServeFleet:
         self.queue_depth = queue_depth
         self.drain_timeout_s = float(drain_timeout_s)
         self.tracer = tracer if tracer is not None else get_tracer()
+        # One registry fleet-wide: router counters + replica-labelled
+        # engine/batcher series + the rollup gauges below, all behind
+        # one /metrics scrape.
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
         self._t0 = time.monotonic()
         self._draining = threading.Event()
         self._stop = threading.Event()
@@ -289,17 +304,27 @@ class ServeFleet:
         # analysis: unlocked-ok(watcher-thread only after init; tests
         # drive poll_once single-threaded instead of starting the watcher)
         self._last_fp = head_fingerprint(self.snapshot_path)
-        engines = [self._make_engine(ckpt, used)
-                   for _ in range(n_replicas)]
+        engines = [self._make_engine(ckpt, used, f"r{i}")
+                   for i in range(n_replicas)]
         self._warm_all(engines)
         self.replicas = [
-            LocalReplica(f"r{i}", eng, self._make_batcher(eng).start())
+            LocalReplica(f"r{i}", eng,
+                         self._make_batcher(eng, f"r{i}").start())
             for i, eng in enumerate(engines)]
         self._current_file = used
         self._current_epoch = int(ckpt.epoch)
         self._current_step = int(ckpt.step)
         self.router = Router(self.replicas, tracer=self.tracer,
+                             registry=self.registry,
                              **(router_kwargs or {}))
+        self.registry.gauge(
+            "ddp_fleet_healthy_replicas",
+            "Replicas currently routable (not ejected, breaker not open)"
+        ).set_function(lambda: float(self.router.healthy_count()))
+        self.registry.counter(
+            "ddp_fleet_swap_commits_total",
+            "Checkpoint hot-swaps committed fleet-wide"
+        ).set_function(self._swap_commit_count)
 
     # -- construction helpers ---------------------------------------------
 
@@ -319,23 +344,32 @@ class ServeFleet:
                 "--snapshot_path first)")
         return loaded
 
-    def _make_engine(self, ckpt, used: str) -> ServeEngine:
+    def _make_engine(self, ckpt, used: str,
+                     replica_id: str) -> ServeEngine:
         from ..models import get_model
         eng = ServeEngine(get_model(self.model_name), ckpt.params,
                           ckpt.batch_stats, self.mesh,
                           buckets=self.buckets,
                           compute_dtype=self.compute_dtype,
-                          tracer=self.tracer)
+                          tracer=self.tracer, registry=self.registry,
+                          metric_labels={"replica": replica_id})
         eng.checkpoint_file = used
         eng.checkpoint_epoch = int(ckpt.epoch)
         eng.checkpoint_step = int(ckpt.step)
         return eng
 
-    def _make_batcher(self, engine: ServeEngine) -> DynamicBatcher:
+    def _make_batcher(self, engine: ServeEngine,
+                      replica_id: str) -> DynamicBatcher:
         return DynamicBatcher(engine, max_batch=self.max_batch,
                               max_wait_ms=self.max_wait_ms,
                               queue_depth=self.queue_depth,
-                              tracer=self.tracer)
+                              tracer=self.tracer, registry=self.registry,
+                              metric_labels={"replica": replica_id})
+
+    def _swap_commit_count(self) -> float:
+        with self._swap_lock:
+            return float(sum(1 for e in self.swap_history
+                             if e["event"] == "swap_commit"))
 
     def _warm_all(self, engines: List[ServeEngine]) -> int:
         """AOT-compile every bucket on every engine; the single-engine
@@ -408,15 +442,17 @@ class ServeFleet:
     def _swap_to(self, ckpt, used: str) -> None:
         t0 = time.monotonic()
         with self.tracer.span("swap_warm"):
-            engines = [self._make_engine(ckpt, used)
-                       for _ in self.replicas]
+            engines = [self._make_engine(ckpt, used, r.replica_id)
+                       for r in self.replicas]
             compiled = self._warm_all(engines)
         warm_s = time.monotonic() - t0
         with self.tracer.span("swap_commit"):
             clean = True
             for replica, eng in zip(self.replicas, engines):
-                clean &= replica.swap(eng, self._make_batcher(eng).start(),
-                                      drain_timeout=self.drain_timeout_s)
+                clean &= replica.swap(
+                    eng,
+                    self._make_batcher(eng, replica.replica_id).start(),
+                    drain_timeout=self.drain_timeout_s)
             with self._swap_lock:
                 from_step = self._current_step
                 self._current_file = used

@@ -19,6 +19,13 @@ the work, which is exactly the dynamic batcher's concurrency model):
   percentiles, shed counts, compiled-executable count) and the swap
   history (``swaps`` — every hot-swap commit/skip; empty list on a
   single-engine server, which has no swap machinery).
+- ``GET /metrics``   the same counters in Prometheus text exposition
+  (obs/registry.py) — per-replica labelled series on a fleet (the
+  fleet's shared registry), the pair's private registry otherwise.
+
+``POST /predict`` honors an ``X-Request-Id`` header on a single-pair
+server (it rides into the request's spans); a fleet ignores it — the
+router mints its own id at admission, the one the flow events use.
 
 The same listener fronts either backend: a single (engine, batcher)
 pair, or a :class:`~ddp_tpu.serve.fleet.ServeFleet` (pass ``fleet=``) —
@@ -37,6 +44,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..obs.registry import CONTENT_TYPE as METRICS_CONTENT_TYPE
 from .batcher import Draining, DynamicBatcher, QueueFull
 from .engine import RequestTooLarge, ServeEngine
 
@@ -104,10 +112,20 @@ class ServeHTTPServer(ThreadingHTTPServer):
 
     # -- backend indirection (single pair vs fleet) ------------------------
 
-    def submit(self, images: np.ndarray, timeout: float) -> np.ndarray:
+    def submit(self, images: np.ndarray, timeout: float,
+               req_id: Optional[str] = None) -> np.ndarray:
         if self.fleet is not None:
+            # The router mints the canonical request id at admission.
             return self.fleet.submit(images, timeout=timeout)
-        return self.batcher.submit(images, timeout=timeout)
+        return self.batcher.submit(images, timeout=timeout, req_id=req_id)
+
+    def metrics_exposition(self) -> Optional[str]:
+        """Prometheus text for ``/metrics``: the fleet's shared registry
+        when fronting a fleet, else the pair's; None when neither backend
+        carries one (a hand-rolled stub in tests)."""
+        backend = self.fleet if self.fleet is not None else self.batcher
+        reg = getattr(backend, "registry", None)
+        return reg.exposition() if reg is not None else None
 
     def healthz_payload(self) -> Tuple[int, dict]:
         if self.fleet is not None:
@@ -170,9 +188,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(status, payload)
         elif self.path == "/stats":
             self._reply(200, self.server.stats_payload())
+        elif self.path == "/metrics":
+            text = self.server.metrics_exposition()
+            if text is None:
+                self._reply(404, {"error": "no metrics registry on this "
+                                           "server's backend"})
+                return
+            body = text.encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", METRICS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # scraper gave up
         else:
             self._reply(404, {"error": f"no route {self.path!r}; try "
-                                       "/predict, /healthz, /stats"})
+                                       "/predict, /healthz, /stats, "
+                                       "/metrics"})
 
     # -- POST /predict -----------------------------------------------------
 
@@ -205,7 +239,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "pixel values must be integers in [0, 255] (uint8 — "
                     "the training loaders' wire format)")
             images = images.astype(np.uint8)
-            logits = self.server.submit(images, timeout=REQUEST_TIMEOUT_S)
+            logits = self.server.submit(
+                images, timeout=REQUEST_TIMEOUT_S,
+                req_id=self.headers.get("X-Request-Id") or None)
         except RequestTooLarge as e:
             self._reply(413, {"error": str(e)})
             return

@@ -48,7 +48,16 @@ Telemetry: ``route`` (replica selection, per routed attempt) and
 ``retry`` (the backoff wait) are ``overlap=True`` handler-thread spans;
 ``eject``/``readmit`` mark rotation changes — all visible in
 ``python -m ddp_tpu.obs`` and the Perfetto export next to the engine's
-pad/h2d/forward/d2h pipeline.
+pad/h2d/forward/d2h pipeline.  The router additionally MINTS a request
+id at admission (``q<N>``) and threads it through every span and the
+replica ``submit`` call, so one request — across retries, replicas and
+a mid-request hot-swap — reconstructs as a single connected flow
+(obs/export.py ``request_chains``; ``python -m ddp_tpu.obs --requests``).
+
+Counters live in the shared :class:`~ddp_tpu.obs.registry
+.MetricsRegistry` (``ddp_router_*`` families; the legacy ``stats()``
+field names are read-only views of the same children), scrapeable at
+``/metrics`` when the fleet passes its registry down.
 """
 from __future__ import annotations
 
@@ -58,9 +67,12 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..obs.registry import MetricsRegistry
 from ..obs.tracer import get_tracer
 from .batcher import Draining, QueueFull
 from .engine import RequestTooLarge, ServeError
+
+_BREAKER_STATE_CODE = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
 
 
 class ReplicaCrashed(ServeError):
@@ -223,7 +235,7 @@ class Router:
                  readmit_max_s: float = 30.0,
                  breaker_trip_after: int = 3,
                  breaker_cooldown_s: float = 1.0,
-                 tracer=None, seed: int = 0):
+                 tracer=None, seed: int = 0, registry=None):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("a router needs at least one replica")
@@ -248,13 +260,50 @@ class Router:
         self._order = ids                 # fixed rotation order
         self._rr = 0                      # analysis: shared-under(_lock)
         self._seq = 0                     # analysis: shared-under(_lock)
-        self.routed = 0                   # analysis: shared-under(_lock)
-        self.retries = 0                  # analysis: shared-under(_lock)
-        self.ejections = 0                # analysis: shared-under(_lock)
-        self.readmissions = 0             # analysis: shared-under(_lock)
-        self.shed_no_replicas = 0         # analysis: shared-under(_lock)
-        self.shed_overloaded = 0          # analysis: shared-under(_lock)
-        self.shed_draining = 0            # analysis: shared-under(_lock)
+        self._req_seq = 0                 # analysis: shared-under(_lock)
+        # Counters live in the metrics registry (internally locked); a
+        # private registry by default keeps instances isolated — the
+        # fleet passes its shared one so /metrics sees the router.
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        self._c_routed = self.registry.counter(
+            "ddp_router_routed_total",
+            "Routing decisions (one per pick round)").labels()
+        self._c_retries = self.registry.counter(
+            "ddp_router_retries_total",
+            "Retry/re-route waits taken inside request budgets").labels()
+        self._c_ejections = self.registry.counter(
+            "ddp_router_ejections_total",
+            "Replicas ejected from rotation by the health prober").labels()
+        self._c_readmissions = self.registry.counter(
+            "ddp_router_readmissions_total",
+            "Ejected replicas re-admitted after a healthy probe").labels()
+        shed = self.registry.counter(
+            "ddp_router_shed_total",
+            "Requests shed at the router, by RouterShed class",
+            labelnames=("reason",))
+        self._c_shed_no_replicas = shed.labels(reason="no_replicas")
+        self._c_shed_overloaded = shed.labels(reason="overloaded")
+        self._c_shed_draining = shed.labels(reason="draining")
+        breaker_g = self.registry.gauge(
+            "ddp_router_breaker_state",
+            "Per-replica circuit state (0 closed, 1 half-open, 2 open)",
+            labelnames=("replica",))
+        served_c = self.registry.counter(
+            "ddp_router_replica_served_total",
+            "Requests served, per replica", labelnames=("replica",))
+        failed_c = self.registry.counter(
+            "ddp_router_replica_failed_total",
+            "Requests failed, per replica", labelnames=("replica",))
+        for rid in self._order:
+            st = self._states[rid]
+            breaker_g.labels(replica=rid).set_function(
+                lambda b=st.breaker:
+                _BREAKER_STATE_CODE[b.snapshot()["state"]])
+            served_c.labels(replica=rid).set_function(
+                lambda s=st: float(s.served))
+            failed_c.labels(replica=rid).set_function(
+                lambda s=st: float(s.failed))
         # Completion timestamps (monotonic) of recently served requests —
         # the live service-rate estimate Retry-After is derived from.
         # analysis: shared-under(_lock)
@@ -262,16 +311,53 @@ class Router:
         self._stop = threading.Event()
         self._health_thread: Optional[threading.Thread] = None
 
+    # Legacy counter names: read-only views of the registry children, so
+    # stats() consumers and tests keep their field names while /metrics
+    # and /stats can never disagree (one storage).
+    @property
+    def routed(self) -> int:
+        return int(self._c_routed.value)
+
+    @property
+    def retries(self) -> int:
+        return int(self._c_retries.value)
+
+    @property
+    def ejections(self) -> int:
+        return int(self._c_ejections.value)
+
+    @property
+    def readmissions(self) -> int:
+        return int(self._c_readmissions.value)
+
+    @property
+    def shed_no_replicas(self) -> int:
+        return int(self._c_shed_no_replicas.value)
+
+    @property
+    def shed_overloaded(self) -> int:
+        return int(self._c_shed_overloaded.value)
+
+    @property
+    def shed_draining(self) -> int:
+        return int(self._c_shed_draining.value)
+
     # -- request path ------------------------------------------------------
 
     def submit(self, images, timeout: Optional[float] = None):
         """Route ``images`` to a healthy replica inside one deadline
         budget; bounded jittered retries on replica failure; immediate
         re-route (no budget charge) when a replica is draining mid-swap;
-        shed with a derived ``Retry-After`` when nothing can take it."""
+        shed with a derived ``Retry-After`` when nothing can take it.
+
+        Mints the request id at admission; every span this request emits
+        (here and downstream in the batcher) carries it."""
         deadline = time.monotonic() + (self.default_timeout_s
                                        if timeout is None else
                                        max(float(timeout), 0.0))
+        with self._lock:
+            self._req_seq += 1
+            req = f"q{self._req_seq}"
         failures = 0
         full: set = set()   # replicas that answered QueueFull this request
         failed_on: set = set()  # replicas that FAILED this request already
@@ -284,20 +370,20 @@ class Router:
                 raise TimeoutError(
                     f"deadline budget exhausted after {failures} "
                     f"failure(s); last error: {last_err!r}")
-            st, seq = self._pick(exclude=full | failed_on | drained)
+            st, seq = self._pick(exclude=full | failed_on | drained,
+                                 req=req)
             if st is None and failed_on:
                 # Every untried replica is out; retrying the one that
                 # already failed this request beats shedding it (a
                 # crashed replica has an empty queue and would otherwise
                 # keep winning least-loaded until its breaker trips).
-                st, seq = self._pick(exclude=full | drained)
+                st, seq = self._pick(exclude=full | drained, req=req)
             if st is None:
                 if full:
                     # Healthy replicas exist but every one of them is at
                     # admission capacity: shed NOW with the backlog-drain
                     # estimate, not a timeout 30 s from now.
-                    with self._lock:
-                        self.shed_overloaded += 1
+                    self._c_shed_overloaded.inc()
                     raise RouterOverloaded(
                         f"all {len(full)} healthy replica(s) at admission "
                         "capacity; retry after backoff",
@@ -308,20 +394,19 @@ class Router:
                     # on its FIRST re-route).  Shed NOW like the
                     # single-engine 503 instead of busy-spinning retry
                     # spans until the deadline turns this into a 500.
-                    with self._lock:
-                        self.shed_draining += 1
+                    self._c_shed_draining.inc()
                     raise RouterDraining(
                         f"all {len(drained)} candidate replica(s) "
                         "draining (fleet shutting down); retry shortly",
                         1.0)
-                with self._lock:
-                    self.shed_no_replicas += 1
+                self._c_shed_no_replicas.inc()
                 raise NoHealthyReplicas(
                     "no healthy replicas (all ejected or circuit-open); "
                     "retry after the next re-admission probe",
                     self._readmit_retry_after())
             try:
-                out = st.replica.submit(images, timeout=remaining)
+                out = st.replica.submit(images, timeout=remaining,
+                                        req=req)
             except (ValueError, TypeError, RequestTooLarge):
                 # The CLIENT's error: no retry, no breaker hit — but a
                 # granted half-open probe slot must not stay latched.
@@ -347,10 +432,10 @@ class Router:
                 drain_hits[rid] = drain_hits.get(rid, 0) + 1
                 if drain_hits[rid] >= 2:
                     drained.add(rid)
+                self._c_retries.inc()
                 with self._lock:
-                    self.retries += 1
                     pause = self._rng.uniform(0.0, 0.005)
-                with self.tracer.span("retry", overlap=True):
+                with self.tracer.span("retry", overlap=True, req=req):
                     time.sleep(min(pause, max(remaining, 0.0)))
                 continue
             except TimeoutError as e:
@@ -373,12 +458,13 @@ class Router:
                     st.failed += 1
                 if failures > self.max_retries:
                     raise
+                self._c_retries.inc()
                 with self._lock:
-                    self.retries += 1
                     # Jittered exponential backoff, never past deadline.
                     pause = (self.backoff_s * (2 ** (failures - 1))
                              * self._rng.uniform(0.5, 1.5))
-                with self.tracer.span("retry", step=seq, overlap=True):
+                with self.tracer.span("retry", step=seq, overlap=True,
+                                      req=req):
                     time.sleep(min(pause,
                                    max(deadline - time.monotonic(), 0.0)))
                 # Queues drain during the backoff: re-admit replicas that
@@ -394,18 +480,18 @@ class Router:
                     del self._served_t[:256]
             return out
 
-    def _pick(self, exclude: set) -> Tuple[Optional[_ReplicaState],
-                                           Optional[int]]:
+    def _pick(self, exclude: set, req: Optional[str] = None
+              ) -> Tuple[Optional[_ReplicaState], Optional[int]]:
         """Least-loaded healthy replica (round-robin tie-break), CLOSED
         breakers first; a replica whose breaker is OPEN-past-cooldown or
         HALF-OPEN is only picked when no CLOSED one exists, and claiming
         its single probe slot happens HERE (``allow()``), so probing N
         candidates never leaks N probes.  Recorded as a ``route`` span."""
-        with self.tracer.span("route", overlap=True):
+        with self.tracer.span("route", overlap=True, req=req):
+            self._c_routed.inc()
             with self._lock:
                 self._seq += 1
                 seq = self._seq
-                self.routed += 1
                 rr = self._rr
                 self._rr += 1
                 live = [self._states[rid]
@@ -490,7 +576,7 @@ class Router:
                         st.ejected = False
                         st.health_failures = 0
                         st.readmit_backoff_s = 0.0
-                        self.readmissions += 1
+                    self._c_readmissions.inc()
                 st.breaker.record_success()   # give it requests again
                 _log(f"router: replica {st.replica.replica_id} healthy "
                      "again; READMITTED to rotation")
@@ -510,10 +596,10 @@ class Router:
                         with self._lock:
                             st.ejected = True
                             st.ejections += 1
-                            self.ejections += 1
                             st.readmit_backoff_s = self.readmit_base_s
                             st.readmit_at = (time.monotonic()
                                              + st.readmit_backoff_s)
+                    self._c_ejections.inc()
                     _log(f"router: replica {st.replica.replica_id} failed "
                          f"{self.eject_after} consecutive health probes; "
                          "EJECTED from rotation (re-admission probes "
@@ -531,6 +617,17 @@ class Router:
         return isinstance(h, dict) and h.get("status") == "ok"
 
     # -- introspection / lifecycle ----------------------------------------
+
+    def healthy_count(self) -> int:
+        """Replicas currently routable (not ejected, breaker not open),
+        from the router's own state — no probe round trips, so the
+        fleet's rollup gauge can read it on every scrape."""
+        with self._lock:
+            states = [self._states[rid] for rid in self._order]
+            ejected = {id(st) for st in states if st.ejected}
+        return sum(1 for st in states
+                   if id(st) not in ejected
+                   and st.breaker.snapshot()["state"] != "open")
 
     def replica_health(self) -> List[dict]:
         """Best-effort health of every replica (dead ones reported, not

@@ -101,7 +101,8 @@ class Trainer:
                  drift_action: str = "abort",
                  guard_window: int = 64,
                  guard_spike_factor: float = 0.0,
-                 guard_action: str = "rollback"):
+                 guard_action: str = "rollback",
+                 registry=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -139,7 +140,8 @@ class Trainer:
         self._health = StepHealthGuard(on_nan, window=guard_window,
                                        spike_factor=guard_spike_factor,
                                        spike_action=guard_action,
-                                       metrics=self.metrics)
+                                       metrics=self.metrics,
+                                       registry=registry)
         self._health.on_lr_backoff = self._apply_lr_backoff
         self._watchdog = watchdog
         self._preemption = preemption
@@ -332,7 +334,8 @@ class Trainer:
             from ..resilience.drift import DriftAuditor
             self._drift = DriftAuditor(mesh, self.state.params,
                                        every=drift_audit_every,
-                                       action=drift_action)
+                                       action=drift_action,
+                                       registry=registry)
 
     def _ckpt_loader(self):
         """The lineage walk's candidate loader, bound to THIS run's mesh

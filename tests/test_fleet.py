@@ -136,7 +136,7 @@ class _StubReplica:
         self.calls = 0
         self.served = 0
 
-    def submit(self, images, timeout=None):
+    def submit(self, images, timeout=None, req=None):
         self.calls += 1
         if self.crashed or self.mode == "crash":
             raise ReplicaCrashed(f"{self.replica_id} is down")
@@ -255,7 +255,7 @@ def test_momentarily_full_replica_readmitted_after_backoff():
     post-backoff pick must prefer a replica that was merely full over
     endlessly re-trying the one that already FAILED this request."""
     class _FullOnce(_StubReplica):
-        def submit(self, images, timeout=None):
+        def submit(self, images, timeout=None, req=None):
             self.calls += 1
             if self.calls == 1:
                 raise QueueFull(f"{self.replica_id} momentarily full")
@@ -361,7 +361,7 @@ def test_fleet_http_all_ejected_is_503_with_retry_after():
     router.health_tick()
 
     class _Facade:                     # the ServeFleet front-door surface
-        def submit(self, images, timeout=None):
+        def submit(self, images, timeout=None, req=None):
             return router.submit(images, timeout=timeout)
 
         def health(self):
@@ -403,14 +403,12 @@ class _Engine:
         self.buckets = (8, 32)
         self.max_rows = 32
         self.delay_s = delay_s
-        self._seq = 0
         self.trace_count = len(self.buckets)
         self.checkpoint_file = "stub.pt"
         self.checkpoint_epoch = 0
         self.checkpoint_step = step
 
-    def forward(self, images):
-        self._seq += 1
+    def forward(self, images, seq=None):
         if self.delay_s:
             time.sleep(self.delay_s)
         return np.full((images.shape[0], 10), self.version, np.float32)
@@ -612,6 +610,64 @@ def test_single_mode_healthz_identity_fields_and_empty_swap_history():
         batcher.drain(timeout=5)
 
 
+def test_http_metrics_endpoint_scrapes_backend_registry():
+    """GET /metrics serves the backend registry's Prometheus exposition
+    (strict-parsed here), and 404s when the backend has no registry —
+    the scrape must never invent an empty registry."""
+    from ddp_tpu.obs.registry import MetricsRegistry, parse_exposition
+    from ddp_tpu.obs.tracer import SpanTracer
+    reg = MetricsRegistry()
+    tracer = SpanTracer()
+    eng = _Engine()
+    batcher = DynamicBatcher(eng, max_wait_ms=1.0, tracer=tracer,
+                             registry=reg,
+                             metric_labels={"replica": "r0"}).start()
+    httpd = ServeHTTPServer(("127.0.0.1", 0), eng, batcher)
+    base = _serve(httpd)
+    rep = HTTPReplica("h0", base)
+    try:
+        # The replica protocol threads the router-minted request id over
+        # HTTP (X-Request-Id) into the remote batcher's queue_wait span.
+        out = rep.submit(_images(2), req="q99")
+        assert out.shape == (2, 10)
+        qw = [s for s in tracer.spans_since(0.0)
+              if s["phase"] == "queue_wait"]
+        assert qw and qw[0]["req"] == "q99"
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"].startswith("text/plain")
+            fams = parse_exposition(r.read().decode())
+        key = (("replica", "r0"),)
+        assert fams["ddp_batcher_submitted_total"]["samples"][
+            ("ddp_batcher_submitted_total", key)] == 1
+        assert fams["ddp_batcher_served_total"]["samples"][
+            ("ddp_batcher_served_total", key)] == 1
+    finally:
+        httpd.close()
+        batcher.drain(timeout=5)
+        tracer.close()
+    # A backend without a registry (custom facade) -> 404, not an
+    # invented empty scrape.
+    class _NoReg:
+        def submit(self, images, timeout=None, req=None):
+            raise TimeoutError("unused")
+
+        def health(self):
+            return {"status": "ok"}
+
+        def stats(self):
+            return {}
+
+    httpd2 = ServeHTTPServer(("127.0.0.1", 0), fleet=_NoReg())
+    base2 = _serve(httpd2)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base2 + "/metrics", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        httpd2.close()
+
+
 # -- HTTPReplica -----------------------------------------------------------
 
 def test_http_replica_speaks_the_replica_protocol():
@@ -808,5 +864,41 @@ def test_fleet_chaos_drill_replica_kill_and_swap_under_load(tmp_path,
     phases = {s["phase"] for s in spans}
     assert {"route", "eject", "swap_warm", "swap_commit"} <= phases
     assert {"forward", "queue_wait"} <= phases      # engines traced too
-    n_events = validate_trace_events(to_trace_events(spans))
+    trace = to_trace_events(spans)
+    n_events = validate_trace_events(trace)
     assert n_events > len(spans)
+    # The request that observed the crash renders as ONE connected flow:
+    # its router-minted id threads route -> retry -> queue_wait -> the
+    # joined batch's engine stages, and the Perfetto export links those
+    # slices with a single s/t.../f chain sharing one flow id.
+    from ddp_tpu.obs.export import (BATCH_PHASES, format_requests_report,
+                                    request_flows)
+    flows = request_flows(spans)
+    retried = {req: f for req, f in flows.items() if f["retries"] >= 1}
+    assert retried, "no request observed the injected crash"
+    req, flow = next(iter(sorted(retried.items())))
+    hops = [h["phase"] for h in flow["hops"]]
+    assert "route" in hops and "retry" in hops and "queue_wait" in hops
+    assert set(hops) & set(BATCH_PHASES), \
+        "retried request never joined a served batch"
+    assert flow["batch_steps"], flow
+    chain_events = [e for e in trace["traceEvents"]
+                    if e.get("ph") in ("s", "t", "f")
+                    and e["name"] == f"req {req}"]
+    assert len(chain_events) == len(flow["hops"])
+    assert len({e["id"] for e in chain_events}) == 1
+    assert chain_events[0]["ph"] == "s" and chain_events[-1]["ph"] == "f"
+    # And `python -m ddp_tpu.obs --requests` names its hop breakdown.
+    report = format_requests_report(spans, top=len(flows))
+    assert req in report and "retry" in report
+    # Registry scrape agrees with the legacy router stats surface.
+    from ddp_tpu.obs.registry import parse_exposition
+    fams = parse_exposition(fleet.registry.exposition())
+
+    def total(name):
+        return sum(fams[name]["samples"].values())
+
+    assert total("ddp_router_ejections_total") == rs["ejections"] >= 1
+    assert total("ddp_router_retries_total") == rs["retries"] >= 1
+    assert total("ddp_engine_rows_served_total") > 0
+    assert total("ddp_fleet_swap_commits_total") == 1

@@ -440,6 +440,17 @@ def test_cli_default_run_spills_and_reports(tmp_path, capsys, monkeypatch):
     # wall_s rides on every record (the shared monotonic clock).
     assert all("wall_s" in r for r in recs)
 
+    # End-of-run Prometheus scrape file next to the metrics JSONL: the
+    # run's registry exposition, strict-parseable, with the prefetch
+    # occupancy counters mirrored from PrefetchStats.
+    from ddp_tpu.obs.registry import parse_exposition
+    fams = parse_exposition(open("m.jsonl.prom").read())
+    assert fams["ddp_prefetch_batches_total"]["samples"][
+        ("ddp_prefetch_batches_total", ())] > 0
+    assert fams["ddp_prefetch_host_seconds_total"]["samples"][
+        ("ddp_prefetch_host_seconds_total", ())] >= 0
+    assert "ddp_guard_decisions_total" in fams
+
     # The obs CLI: phase table + histogram + slowest-K + Perfetto export.
     rc = obs_main(["trace_spill.jsonl", "--perfetto", "trace.json",
                    "--top", "3"])
@@ -473,3 +484,168 @@ def test_cli_obs_off_emits_nothing(tmp_path, capsys, monkeypatch):
     assert not any(r.get("event") in ("live", "phase_stragglers")
                    for r in recs)
     assert any("loss" in r for r in recs)  # the loss stream is untouched
+
+
+# ---------------------------------------------------------------------------
+# request-scoped tracing: flow events, chains, the --requests view
+
+
+def _serve_spans_with_retry():
+    """A two-request serve spill shaped like the chaos drill: q1's first
+    routing attempt dies with the replica (retry span), the retry lands
+    on the post-swap replica's batch (global seq 9) — so its chain must
+    connect across hosts.  q2 is a boring one-hop request."""
+    def sp(phase, start, dur, host, step=None, req=None, overlap=False):
+        return {"phase": phase, "start_s": start, "dur_s": dur,
+                "host": host, "step": step, "req": req,
+                "overlap": overlap}
+    return [
+        # q1: route -> crash observed -> retry -> queue_wait on the
+        # replacement replica -> that batch's engine stages (step 9).
+        sp("route", 0.000, 0.300, 0, req="q1", overlap=True),
+        sp("retry", 0.050, 0.001, 0, req="q1", overlap=True),
+        sp("queue_wait", 0.060, 0.030, 1, step=9, req="q1"),
+        sp("batch_form", 0.090, 0.002, 1, step=9),
+        sp("pad", 0.092, 0.001, 1, step=9),
+        sp("h2d", 0.093, 0.002, 1, step=9),
+        sp("forward", 0.095, 0.080, 1, step=9),
+        sp("d2h", 0.175, 0.002, 1, step=9),
+        # q2: single-hop on the original replica (batch step 5).
+        sp("route", 0.010, 0.040, 0, req="q2", overlap=True),
+        sp("queue_wait", 0.012, 0.005, 0, step=5, req="q2"),
+        sp("batch_form", 0.017, 0.001, 0, step=5),
+        sp("forward", 0.018, 0.020, 0, step=5),
+    ]
+
+
+def test_request_chain_joins_engine_stages_across_replicas():
+    chains = export.request_chains(_serve_spans_with_retry())
+    assert set(chains) == {"q1", "q2"}
+    q1 = [s["phase"] for s in chains["q1"]]
+    # The chain has q1's own spans plus step 9's engine stages — and
+    # nothing from step 5 (q2's batch).
+    assert q1 == ["route", "retry", "queue_wait", "batch_form", "pad",
+                  "h2d", "forward", "d2h"]
+    assert {s["host"] for s in chains["q1"]} == {0, 1}
+    assert [s["phase"] for s in chains["q2"]] == [
+        "route", "queue_wait", "batch_form", "forward"]
+
+
+def test_flow_events_render_request_as_one_connected_chain():
+    """The acceptance shape: a crash->retry->hot-swap request exports as
+    ONE Perfetto flow (s -> t... -> f sharing an id), each flow event
+    bound to its slice (same pid/tid, ts at the slice midpoint)."""
+    spans = _serve_spans_with_retry()
+    trace = export.to_trace_events(spans)
+    assert export.validate_trace_events(trace) > 0
+    flows = [e for e in trace["traceEvents"]
+             if e.get("ph") in ("s", "t", "f")]
+    by_name = {}
+    for e in flows:
+        by_name.setdefault(e["name"], []).append(e)
+    assert set(by_name) == {"req q1", "req q2"}
+    for name, chain in by_name.items():
+        assert len({e["id"] for e in chain}) == 1  # one flow id
+        assert chain[0]["ph"] == "s" and chain[-1]["ph"] == "f"
+        assert all(e["ph"] == "t" for e in chain[1:-1])
+        assert chain[-1]["bp"] == "e"
+    # q1's chain spans both replica processes and covers every hop.
+    q1 = by_name["req q1"]
+    assert len(q1) == 8 and {e["pid"] for e in q1} == {0, 1}
+    # Each flow event binds inside its slice: a matching X slice exists
+    # on the same pid/tid whose [ts, ts+dur] contains the flow ts.
+    slices = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    for e in flows:
+        assert any(s["pid"] == e["pid"] and s["tid"] == e["tid"]
+                   and s["ts"] <= e["ts"] <= s["ts"] + s["dur"]
+                   for s in slices), f"unbound flow event {e}"
+
+
+def test_request_flows_totals_retries_and_report():
+    spans = _serve_spans_with_retry()
+    flows = export.request_flows(spans)
+    q1 = flows["q1"]
+    assert q1["retries"] == 1 and q1["batch_steps"] == [9]
+    assert q1["total_ms"] == pytest.approx(300.0)  # 0.000 -> 0.300
+    assert flows["q2"]["retries"] == 0
+    assert flows["q2"]["batch_steps"] == [5]
+    # Slowest-first ordering and the per-hop text breakdown.
+    assert [r for r, _ in export.slowest_requests(spans, 5)] == [
+        "q1", "q2"]
+    rep = export.format_requests_report(spans, top=5)
+    assert "q1" in rep and "1 retries" in rep
+    assert "retry" in rep and "forward" in rep and "@9" in rep
+    # A train spill has no request ids — the report says so.
+    assert "no request-scoped spans" in export.format_requests_report(
+        _sample_spans())
+
+
+# ---------------------------------------------------------------------------
+# python -m ddp_tpu.obs: exit-2 diagnoses, --requests, --ledger
+
+
+def _write_spill(path, spans):
+    with open(path, "w") as f:
+        for s in spans:
+            f.write(json.dumps(s) + "\n")
+
+
+def test_obs_main_diagnoses_unusable_spills(tmp_path, capsys):
+    from ddp_tpu.obs.__main__ import main as obs_main
+    # Missing file.
+    assert obs_main([str(tmp_path / "nope.jsonl")]) == 2
+    assert "cannot read spill" in capsys.readouterr().err
+    # Empty spill.
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert obs_main([empty]) == 2
+    assert "no spans" in capsys.readouterr().err
+    # Mixed train+serve concatenation.
+    mixed = str(tmp_path / "mixed.jsonl")
+    _write_spill(mixed, _sample_spans() + _serve_spans_with_retry())
+    assert obs_main([mixed]) == 2
+    assert "mixed train+serve" in capsys.readouterr().err
+
+
+def test_obs_main_requests_view(tmp_path, capsys):
+    from ddp_tpu.obs.__main__ import main as obs_main
+    spill = str(tmp_path / "serve.jsonl")
+    _write_spill(spill, _serve_spans_with_retry())
+    assert obs_main([spill, "--requests"]) == 0
+    out = capsys.readouterr().out
+    assert "2 request(s)" in out and "q1" in out
+    assert obs_main([spill, "--requests", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["q1"]["retries"] == 1
+
+
+def test_obs_main_ledger_join(tmp_path, capsys):
+    from ddp_tpu.obs.__main__ import main as obs_main
+    spill = str(tmp_path / "train.jsonl")
+    _write_spill(spill, _sample_spans())
+    calib = str(tmp_path / "calib.json")
+    with open(calib, "w") as f:
+        json.dump({"predicted_ms_per_step": {"train_step@dp8": 50.0,
+                                             "train_step@accum": 1.0},
+                   "coefficients": {"c_flop": 1e-12}}, f)
+    assert obs_main([spill, "--ledger", calib, "--ledger_scale", "2",
+                     "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    row = {r["phase"]: r for r in doc["rows"]}["dispatch"]
+    # The @dp variant wins over @accum; median dispatch is 200 ms
+    # (0.1/0.3/0.2 s) vs 50 ms predicted x2 scale -> +100% gap.
+    assert row["program"] == "train_step@dp8"
+    assert row["predicted_ms"] == pytest.approx(100.0)
+    assert row["measured_ms"] == pytest.approx(200.0)
+    assert row["gap_pct"] == pytest.approx(100.0)
+    # (>1 is possible here: the sample spill is two hosts whose serial
+    # lanes each tile their own wall, merged onto one clock.)
+    assert doc["pred_scale"] == 2.0 and doc["serial_coverage"] > 0
+    # Host-side phases the model can't price are listed, not dropped.
+    assert "data_wait" in {r["phase"] for r in doc["unpriced"]}
+    # A calibration record without predictions is an exit-2 diagnosis.
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"coefficients": {}}, f)
+    assert obs_main([spill, "--ledger", bad]) == 2
+    assert "predicted_ms_per_step" in capsys.readouterr().err

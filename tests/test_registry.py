@@ -1,0 +1,204 @@
+"""The metrics registry (ddp_tpu/obs/registry.py): exposition
+correctness under the strict parser, label escaping, histogram bucket
+semantics, thread safety, and the registry migration's two-views-of-one-
+truth contract on the serve components (PR 14 tentpole)."""
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from ddp_tpu.obs.registry import (CONTENT_TYPE, DEFAULT_BUCKETS,
+                                  MetricsRegistry, parse_exposition)
+
+
+def test_exposition_round_trips_through_strict_parser():
+    reg = MetricsRegistry()
+    reg.counter("jobs_total", "Jobs processed").inc(3)
+    g = reg.gauge("depth", "Queue depth", ("replica",))
+    g.labels(replica="r0").set(4)
+    g.labels(replica="r1").set(0)
+    h = reg.histogram("lat_ms", "Latency", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.exposition()
+    fams = parse_exposition(text)
+    assert fams["jobs_total"]["type"] == "counter"
+    assert fams["jobs_total"]["help"] == "Jobs processed"
+    assert fams["jobs_total"]["samples"][("jobs_total", ())] == 3
+    assert fams["depth"]["samples"][
+        ("depth", (("replica", "r0"),))] == 4
+    s = fams["lat_ms"]["samples"]
+    assert s[("lat_ms_bucket", (("le", "1"),))] == 1
+    assert s[("lat_ms_bucket", (("le", "10"),))] == 2
+    assert s[("lat_ms_bucket", (("le", "+Inf"),))] == 3
+    assert s[("lat_ms_sum", ())] == pytest.approx(55.5)
+    assert s[("lat_ms_count", ())] == 3
+    assert "version=0.0.4" in CONTENT_TYPE
+
+
+def test_label_value_escaping_round_trips():
+    reg = MetricsRegistry()
+    c = reg.counter("odd_total", "", ("path",))
+    nasty = 'a\\b"c\nd'
+    c.labels(path=nasty).inc()
+    fams = parse_exposition(reg.exposition())
+    assert fams["odd_total"]["samples"][
+        ("odd_total", (("path", nasty),))] == 1
+
+
+def test_parser_rejects_malformed_exposition():
+    with pytest.raises(ValueError, match="no preceding # TYPE"):
+        parse_exposition("loose_sample 1\n")
+    with pytest.raises(ValueError, match="unknown TYPE"):
+        parse_exposition("# TYPE x foo\nx 1\n")
+    with pytest.raises(ValueError, match="duplicate TYPE"):
+        parse_exposition("# TYPE x counter\n# TYPE x counter\nx 1\n")
+    with pytest.raises(ValueError, match="after its samples"):
+        parse_exposition("# TYPE x counter\nx 1\n# TYPE x counter\n")
+    with pytest.raises(ValueError, match="duplicate series"):
+        parse_exposition("# TYPE x counter\nx 1\nx 2\n")
+    with pytest.raises(ValueError, match="bad sample value"):
+        parse_exposition("# TYPE x counter\nx one\n")
+    with pytest.raises(ValueError, match="bad escape"):
+        parse_exposition('# TYPE x counter\nx{a="\\q"} 1\n')
+    with pytest.raises(ValueError, match="malformed sample"):
+        parse_exposition('# TYPE x counter\nx{a="b 1\n')
+    # Histogram structure: monotone cumulative buckets ending at +Inf
+    # whose _count equals the +Inf bucket.
+    with pytest.raises(ValueError, match="missing \\+Inf"):
+        parse_exposition('# TYPE h histogram\nh_bucket{le="1"} 1\n'
+                         "h_sum 1\nh_count 1\n")
+    with pytest.raises(ValueError, match="not monotone"):
+        parse_exposition('# TYPE h histogram\nh_bucket{le="1"} 5\n'
+                         'h_bucket{le="+Inf"} 3\nh_sum 1\nh_count 3\n')
+    with pytest.raises(ValueError, match="missing _sum or _count"):
+        parse_exposition('# TYPE h histogram\n'
+                         'h_bucket{le="+Inf"} 1\n')
+    with pytest.raises(ValueError, match="_count"):
+        parse_exposition('# TYPE h histogram\n'
+                         'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 9\n')
+
+
+def test_family_registration_is_idempotent_but_schema_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", "first", ("k",))
+    b = reg.counter("x_total", "second declaration ignored", ("k",))
+    assert a is b
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.gauge("x_total", "", ("k",))
+    with pytest.raises(ValueError, match="re-registered"):
+        reg.counter("x_total", "", ("other",))
+    with pytest.raises(ValueError, match="bad metric name"):
+        reg.counter("bad name")
+    with pytest.raises(ValueError, match="bad label name"):
+        reg.counter("y_total", "", ("le",))
+    with pytest.raises(ValueError, match="labels"):
+        a.labels(wrong="v")
+    with pytest.raises(ValueError, match="counters only go up"):
+        reg.counter("z_total").inc(-1)
+
+
+def test_counter_and_histogram_thread_safety():
+    """16 threads hammer one counter child and one histogram child; the
+    totals must be exact (the lint in test_analysis audits the lock
+    discipline statically; this is the dynamic half)."""
+    reg = MetricsRegistry()
+    c = reg.counter("hot_total")
+    h = reg.histogram("hot_ms", buckets=DEFAULT_BUCKETS)
+    per, nthreads = 500, 16
+
+    def work(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(per):
+            c.inc()
+            h.observe(float(rng.uniform(0, 6000)))
+
+    ts = [threading.Thread(target=work, args=(i,)) for i in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == per * nthreads
+    bounds, cum, h_sum, h_count = h.labels().snapshot()
+    assert h_count == per * nthreads == cum[-1]
+    assert cum == sorted(cum)  # cumulative monotone
+    parse_exposition(reg.exposition())  # and the scrape is well-formed
+
+
+def test_function_backed_child_reads_component_at_scrape_time():
+    reg = MetricsRegistry()
+    state = {"served": 0}
+    reg.counter("served_total").set_function(
+        lambda: float(state["served"]))
+    assert parse_exposition(reg.exposition())["served_total"]["samples"][
+        ("served_total", ())] == 0
+    state["served"] = 41
+    assert reg.counter("served_total").value == 41
+
+
+def test_infinity_and_integer_value_formatting():
+    reg = MetricsRegistry()
+    g = reg.gauge("v")
+    g.set(2.0)
+    assert "v 2\n" in reg.exposition()
+    g.set(2.5)
+    assert "v 2.5\n" in reg.exposition()
+    assert math.isinf(parse_exposition("# TYPE w gauge\nw +Inf\n")
+                      ["w"]["samples"][("w", ())])
+
+
+def test_registries_are_instance_scoped():
+    """Two registries never share state — the per-instance-by-default
+    contract that keeps tests and repeated cli.run calls independent."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("n_total").inc()
+    assert b.counter("n_total").value == 0
+
+
+def test_batcher_stats_and_registry_agree(monkeypatch):
+    """The migration contract on a live component: DynamicBatcher's
+    legacy stats() counters are read-only views of its registry children
+    — one truth, two surfaces."""
+    from ddp_tpu.serve.batcher import DynamicBatcher
+    from ddp_tpu.serve.engine import RequestTooLarge
+
+    class _Eng:
+        input_shape = (32, 32, 3)
+        buckets = (8,)
+        max_rows = 8
+        trace_count = 1
+
+        def stats(self):
+            return {"buckets": [8], "compiled_executables": 1,
+                    "checkpoint": {"file": None, "epoch": None,
+                                   "step": None}}
+
+        def forward(self, images, seq=None):
+            n = images.shape[0]
+            return np.zeros((n, 10), np.float32)
+
+    reg = MetricsRegistry()
+    b = DynamicBatcher(_Eng(), max_wait_ms=1.0, registry=reg,
+                       metric_labels={"replica": "r7"}).start()
+    try:
+        img = np.zeros((2, 32, 32, 3), np.uint8)
+        out = b.submit(img, timeout=10)
+        assert out.shape == (2, 10)
+        with pytest.raises(RequestTooLarge):
+            b.submit(np.zeros((9, 32, 32, 3), np.uint8), timeout=10)
+    finally:
+        b.drain(timeout=10)
+    assert b.submitted == 1 and b.served_requests == 1
+    assert b.rejected_oversize == 1
+    st = b.stats()
+    assert st["submitted"] == 1 and st["rejected_oversize"] == 1
+    fams = parse_exposition(reg.exposition())
+    key = (("replica", "r7"),)
+    assert fams["ddp_batcher_submitted_total"]["samples"][
+        ("ddp_batcher_submitted_total", key)] == 1
+    assert fams["ddp_batcher_rejected_oversize_total"]["samples"][
+        ("ddp_batcher_rejected_oversize_total", key)] == 1
+    # The served-request latency histogram observed exactly one request.
+    assert fams["ddp_batcher_request_latency_ms"]["samples"][
+        ("ddp_batcher_request_latency_ms_count", key)] == 1

@@ -275,7 +275,6 @@ class _StubEngine:
         self.buckets = (8, max_rows)
         self.max_rows = max_rows
         self.delay_s = delay_s
-        self._seq = 0
         self.trace_count = len(self.buckets)
         self.calls = []
 
@@ -284,8 +283,7 @@ class _StubEngine:
                 "compiled_executables": self.trace_count,
                 "checkpoint": {"file": None, "epoch": None, "step": None}}
 
-    def forward(self, images):
-        self._seq += 1
+    def forward(self, images, seq=None):
         self.calls.append(images.shape[0])
         if self.delay_s:
             time.sleep(self.delay_s)
@@ -498,7 +496,7 @@ def test_serve_spans_spill_and_export_to_perfetto(tmp_path, engine8):
     from ddp_tpu.obs.tracer import SpanTracer
     spill = str(tmp_path / "serve_spill.jsonl")
     tracer = SpanTracer(spill_path=spill)
-    old_tracer, old_seq = engine8.tracer, engine8._seq
+    old_tracer = engine8.tracer
     engine8.tracer = tracer
     try:
         b = DynamicBatcher(engine8, max_wait_ms=1.0, tracer=tracer).start()
