@@ -301,6 +301,19 @@ def _parse_args():
                    help="With --serve: serve this trained checkpoint "
                         "(head path or directory) instead of fresh-init "
                         "weights — the full lineage-load path bench")
+    p.add_argument("--chaos", action="store_true",
+                   help="Run the chaos campaign (tools/chaos_campaign.py): "
+                        "the DDP_TPU_FAULT drill matrix under "
+                        "python -m ddp_tpu.supervise, scored per drill on "
+                        "restarts used, time-to-recover, and final-state "
+                        "bit-parity vs an undisturbed control.  Record: "
+                        "CHAOS_r01.json (NOT a BENCH_* headline — "
+                        "bench_trend ignores CHAOS_* files)")
+    p.add_argument("--chaos_out", default="CHAOS_r01.json",
+                   help="--chaos scorecard path (default CHAOS_r01.json)")
+    p.add_argument("--chaos_drills", default=None, metavar="D1,D2,...",
+                   help="--chaos drill subset (default: the full matrix; "
+                        "CI smoke uses sigterm_step,watchdog_stall)")
     return p.parse_args()
 
 
@@ -316,6 +329,9 @@ def main() -> None:
                          "has no program to dump in --sweep/--batch_sweep/"
                          "--pipeline/--e2e/--stream_attr/--serve/--tp_sweep/"
                          "--ckpt_bench modes")
+    if args.chaos:
+        _bench_chaos(args)
+        return
     if args.ckpt_bench_child:
         _bench_ckpt_child(args)
         return
@@ -361,6 +377,22 @@ def main() -> None:
             args.profile_dir is None and jax.default_backend() != "cpu":
         print(json.dumps(_bench_step(args, bf16=True, extras=False)[0]),
               file=sys.stderr)
+
+
+def _bench_chaos(args) -> None:
+    """The chaos campaign, as a bench mode: a subprocess around
+    tools/chaos_campaign.py (each drill spawns its own supervised
+    training children with a pinned CPU-mesh environment — the tool
+    owns that env, not this process).  Propagates the campaign's
+    pass/fail exit."""
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "chaos_campaign.py")
+    cmd = [sys.executable, tool, "--out", args.chaos_out]
+    if args.chaos_drills:
+        cmd += ["--drills", args.chaos_drills]
+    rc = subprocess.call(cmd)
+    if rc != 0:
+        raise SystemExit(rc)
 
 
 def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:

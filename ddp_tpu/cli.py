@@ -492,9 +492,12 @@ def run(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         # epoch boundary (resilience/preemption.py's collective decision)
         # with the emergency checkpoint already on disk, so the graceful
         # shutdown barrier completes — no peer is left in a collective.
+        cue = ("the supervisor relaunches with --resume automatically"
+               if os.environ.get("DDP_TPU_SUPERVISED")
+               else "relaunch with --resume to continue")
         print(f"preempted: {e}; exiting with status "
-              f"{EMERGENCY_CHECKPOINT_EXIT_STATUS} — relaunch with "
-              "--resume to continue", file=sys.stderr)
+              f"{EMERGENCY_CHECKPOINT_EXIT_STATUS} — {cue}",
+              file=sys.stderr)
         sys.stdout.flush()
         sys.stderr.flush()
         dist.shutdown()

@@ -40,6 +40,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "MetricsRegistry", "parse_exposition", "CONTENT_TYPE",
+    "SECONDS_BUCKETS",
 ]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -51,6 +52,11 @@ _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 # request latencies are the histograms this codebase keeps).
 DEFAULT_BUCKETS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                    500.0, 1000.0, 2500.0, 5000.0)
+
+# Seconds-flavoured buckets for the coarse timings (supervisor recovery,
+# backoff waits) where the ms grid would dump everything in +Inf.
+SECONDS_BUCKETS = (0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+                   300.0, 600.0)
 
 
 def _escape_label_value(v: str) -> str:

@@ -22,24 +22,39 @@ survive":
 - ``faults``     test-only fault injection (tear a checkpoint, poison the
                  loss at step k, SIGTERM at epoch k, stall a host) driving
                  ``tests/test_resilience.py`` and the CLI drills.
+- ``supervisor`` THE restart wrapper the exit codes below cue: launches
+                 the run as a child, relaunches with ``--resume`` on
+                 preemption/stall/transient crash under a backoff budget,
+                 shrinks the mesh to the surviving devices, and stops with
+                 a named diagnosis when a failure recurs deterministically.
 
-Exit-status contract (a restart wrapper keys off these):
+Exit-status contract (``python -m ddp_tpu.supervise`` keys off these):
   0    normal completion
   75   (EX_TEMPFAIL) preempted; emergency checkpoint on disk — relaunch
        with ``--resume``
   124  watchdog expired: no step/epoch progress within ``--watchdog_secs``
   else a real failure; inspect before relaunching
+
+The supervisor's OWN exits continue the table:
+  86   restart budget exhausted (failure ledger printed; newest verifiable
+       checkpoint still on disk for a manual relaunch)
+  87   deterministic failure diagnosed — the same drift/guard signature at
+       the same step twice; relaunching would re-prove it, not fix it
 """
 from .guard import NonFiniteLossError, StepHealthGuard
 from .lineage import (CheckpointLineage, latest_verifiable,
                       load_latest_verifiable)
 from .preemption import (EMERGENCY_CHECKPOINT_EXIT_STATUS, PreemptionGuard,
                          PreemptionInterrupt)
+from .supervisor import (SUPERVISOR_BUDGET_EXIT_STATUS,
+                         SUPERVISOR_DETERMINISTIC_EXIT_STATUS, Supervisor)
 from .watchdog import WATCHDOG_EXIT_STATUS, Watchdog
 
 __all__ = [
     "CheckpointLineage", "EMERGENCY_CHECKPOINT_EXIT_STATUS",
     "NonFiniteLossError", "PreemptionGuard", "PreemptionInterrupt",
-    "StepHealthGuard", "WATCHDOG_EXIT_STATUS", "Watchdog",
+    "SUPERVISOR_BUDGET_EXIT_STATUS",
+    "SUPERVISOR_DETERMINISTIC_EXIT_STATUS", "StepHealthGuard",
+    "Supervisor", "WATCHDOG_EXIT_STATUS", "Watchdog",
     "latest_verifiable", "load_latest_verifiable",
 ]

@@ -23,6 +23,10 @@ Faults:
                         must degrade to the epoch boundary, warned once
   ``stall_at_epoch``    put one rank to sleep at the end of epoch k — the
                         hung-peer scenario the watchdog bounds
+  ``fail_ckpt_write``   the async checkpoint write of epoch k dies on the
+                        WRITER THREAD (a full disk / lost mount) — drives
+                        the deferred ``trainer._save_error`` surfacing at
+                        the next join, with the lineage left un-torn
 
 Serve-side faults (the fleet chaos drills — tests/test_fleet.py and the
 CI fleet smoke):
@@ -41,7 +45,7 @@ Env surface for subprocess drills (``DDP_TPU_FAULT``): semicolon-separated
 specs ``kind@key=val,key=val`` — e.g.
 ``sigterm@epoch=1``, ``sigterm@step=12``, ``poison@step=5``,
 ``flip_param_bit@step=6,replica=1``, ``poison_batch@step=9,scale=1e4``,
-``stall@epoch=0,rank=1,secs=600``.  Serve processes
+``stall@epoch=0,rank=1,secs=600``, ``fail_ckpt_write@epoch=1``.  Serve processes
 (``python -m ddp_tpu.serve --fleet N``) parse the same variable through
 :func:`install_serve_faults` with the serve vocabulary:
 ``crash_replica@requests=25,replica=0``, ``slow_forward@ms=200,replica=1``,
@@ -239,6 +243,48 @@ def torn_data_state(path: str) -> None:
     sys.stderr.flush()
 
 
+def fail_ckpt_write(trainer, epoch: int) -> None:
+    """The async checkpoint write of epoch ``epoch`` dies on the WRITER
+    THREAD, once — the full-disk / lost-NFS-mount model.  The injection
+    point is ``lineage.preserve_head()``, the write closure's FIRST call:
+    the head file is never opened, so the previous snapshot (and the
+    whole lineage) stays byte-identical — the "un-torn" half of the
+    drill.  The error lands in ``trainer._save_error`` and must surface
+    at the next ``_join_pending_save`` boundary (a silently-lost
+    checkpoint must not look saved).
+
+    Rank-0 only (the preserve/commit bookkeeping is rank-0-gated in the
+    write closure) — which is every CPU drill in the suite.  The target
+    epoch rides a FIFO handed from the main-thread save call to the
+    writer thread: joins serialize the writers, so the order matches."""
+    import collections
+    if trainer.lineage is None:
+        raise ValueError("fail_ckpt_write needs a trainer with a "
+                         "snapshot path (no lineage, no writer thread)")
+    orig_inner = trainer._save_checkpoint_inner
+    orig_preserve = trainer.lineage.preserve_head
+    pending = collections.deque()
+    fired = [False]
+
+    def inner(ep, data_state=None):
+        pending.append(int(ep))
+        return orig_inner(ep, data_state)
+
+    def preserve():
+        ep = pending.popleft() if pending else None
+        if not fired[0] and ep == int(epoch):
+            fired[0] = True
+            print(f"[fault] failing the checkpoint write of epoch {ep} "
+                  "on the writer thread", file=sys.stderr)
+            sys.stderr.flush()
+            raise OSError(28, "injected checkpoint write failure "
+                              f"at epoch {ep}")
+        return orig_preserve()
+
+    trainer._save_checkpoint_inner = inner
+    trainer.lineage.preserve_head = preserve
+
+
 def sigterm_at_epoch(trainer, epoch: int) -> None:
     """Deliver SIGTERM to this process right after epoch ``epoch`` runs —
     before the trainer's save gate and preemption check, like a real
@@ -392,6 +438,8 @@ def install_env_faults(trainer) -> None:
             stall_at_epoch(trainer, int(kv["epoch"]),
                            float(kv.get("secs", "3600")),
                            rank=int(kv["rank"]) if "rank" in kv else None)
+        elif kind == "fail_ckpt_write":
+            fail_ckpt_write(trainer, int(kv["epoch"]))
         else:
             raise ValueError(f"unknown {FAULT_ENV} fault kind {kind!r} "
                              f"in {part!r}")

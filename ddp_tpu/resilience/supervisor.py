@@ -1,0 +1,525 @@
+"""Run supervisor — the restart wrapper the exit-status contract was
+written for (resilience/__init__ and preemption.py:40 both call exit 75
+"the restart wrapper's cue"; this module IS that wrapper).
+
+``python -m ddp_tpu.supervise -- multigpu.py 10 1 --snapshot ...`` launches
+the training run as a child process and closes the recovery loop no
+operator should sit in:
+
+- exit 75 (preempted): the emergency checkpoint is already on disk —
+  relaunch immediately with ``--resume``, no backoff (preemption is the
+  scheduler's doing, not the run's).
+- exit 124 (watchdog): the run stalled — relaunch with ``--resume`` under
+  exponential backoff plus jitter (a wedged host often needs time to be
+  replaced, and a thundering herd of restarts is how fleets melt).
+- other nonzero: a classified crash — relaunch under the same backoff,
+  but only while the failure ledger calls the death TRANSIENT.
+
+Elastic restarts: before each relaunch the supervisor probes the live
+device count and shrinks ``--mesh_shape`` to the largest surviving
+``(d, m)`` the checkpoint reshards onto (``load_for_mesh`` makes any
+shape restorable), then grows back to the full mesh at the next relaunch
+once devices return.  Growth only ever happens at a relaunch boundary —
+a running child's mesh is immutable.
+
+The failure ledger tails the child's metrics JSONL between launches and
+keeps, per death, the exit code, the mesh it ran on, and the last
+guard/drift event it recorded.  The same ``drift_detected``/``spike_*``
+event at the same global step twice is not bad luck — it is a poisoned
+step that will kill every future attempt identically, so the supervisor
+stops burning restart budget and exits with a named diagnosis.
+
+Supervisor exit codes (continuing the child contract):
+  0    child completed (possibly after restarts)
+  86   restart budget exhausted — ledger printed, newest verifiable
+       checkpoint still on disk for a manual relaunch
+  87   deterministic failure diagnosed (same failure signature at the
+       same step twice) — crash-looping would spend budget re-proving it
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+SUPERVISOR_BUDGET_EXIT_STATUS = 86
+SUPERVISOR_DETERMINISTIC_EXIT_STATUS = 87
+
+# Test/CI seam: when set, the device probe trusts this count instead of
+# spawning a JAX interpreter (a multi-second import on the CPU tier).
+PROBE_ENV = "DDP_TPU_SUPERVISE_DEVICES"
+
+# Set in every child's environment so cli.py's preemption message can say
+# "the supervisor relaunches automatically" instead of telling a human to
+# type --resume.
+SUPERVISED_ENV = "DDP_TPU_SUPERVISED"
+
+
+# -- pure helpers (unit-tested directly) -----------------------------------
+
+
+def classify_exit(returncode: int) -> str:
+    """``preempted`` (75) / ``stalled`` (124) / ``crash`` (anything else
+    nonzero, including signal deaths reported as negative returncodes)."""
+    from .preemption import EMERGENCY_CHECKPOINT_EXIT_STATUS
+    from .watchdog import WATCHDOG_EXIT_STATUS
+    if returncode == EMERGENCY_CHECKPOINT_EXIT_STATUS:
+        return "preempted"
+    if returncode == WATCHDOG_EXIT_STATUS:
+        return "stalled"
+    return "crash"
+
+
+def backoff_delay(restart_no: int, *, base: float, cap: float,
+                  jitter: float, rng: random.Random) -> float:
+    """``min(base * 2**restart_no, cap)`` spread by ``±jitter`` (fractional)
+    — the standard decorrelation so a rack of supervisors whose children
+    died together does not relaunch them together."""
+    nominal = min(base * (2.0 ** restart_no), cap)
+    spread = 1.0 + jitter * (2.0 * rng.random() - 1.0)
+    return max(0.0, nominal * spread)
+
+
+def shrink_mesh(full: Tuple[int, int], ndev: int) -> Tuple[int, int]:
+    """The largest surviving ``(d, m)`` under ``full = (D, M)`` that fits
+    on ``ndev`` devices.  The model axis is load-bearing (the checkpoint's
+    layer shards assume M-way TP unless resharded), so shrink the DATA
+    axis first and only split M when even one M-wide replica no longer
+    fits — then the largest divisor of M that does."""
+    d, m = int(full[0]), int(full[1])
+    ndev = max(1, int(ndev))
+    if d * m <= ndev:
+        return (d, m)
+    if m <= ndev:
+        return (max(1, ndev // m), m)
+    # Not even one full model replica fits: largest divisor of M <= ndev.
+    for cand in range(ndev, 0, -1):
+        if m % cand == 0:
+            return (1, cand)
+    return (1, 1)
+
+
+def _get_flag(argv: Sequence[str], name: str) -> Optional[str]:
+    for i, a in enumerate(argv):
+        if a == name and i + 1 < len(argv):
+            return argv[i + 1]
+        if a.startswith(name + "="):
+            return a.split("=", 1)[1]
+    return None
+
+
+def _set_flag(argv: Sequence[str], name: str, value: str) -> List[str]:
+    out = list(argv)
+    for i, a in enumerate(out):
+        if a == name and i + 1 < len(out):
+            out[i + 1] = value
+            return out
+        if a.startswith(name + "="):
+            out[i] = f"{name}={value}"
+            return out
+    out.extend([name, value])
+    return out
+
+
+def _ensure_resume(argv: Sequence[str]) -> List[str]:
+    out = list(argv)
+    if "--resume" not in out:
+        out.append("--resume")
+    return out
+
+
+# -- failure ledger --------------------------------------------------------
+
+
+def _iter_new_events(path: Optional[str], offset: int):
+    """Parse the ``event`` records appended to the metrics JSONL since
+    ``offset``; returns ``(events, new_offset)``.  Only complete lines are
+    consumed — a torn trailing line is left for the next read."""
+    if not path:
+        return [], offset
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return [], offset
+    if size < offset:  # replaced/truncated by a fresh run
+        offset = 0
+    events = []
+    try:
+        with open(path, "r") as f:
+            f.seek(offset)
+            chunk = f.read()
+    except OSError:
+        return [], offset
+    end = chunk.rfind("\n")
+    if end < 0:
+        return [], offset
+    for line in chunk[:end].splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "event" in rec:
+            events.append(rec)
+    return events, offset + end + 1
+
+
+def _failure_signature(events) -> Optional[Tuple[str, int]]:
+    """The deterministic-failure fingerprint of one death: the LAST
+    drift/guard anomaly event, keyed ``(what, step)``.  ``None`` when the
+    death left no such event (nothing to match a recurrence against)."""
+    for rec in reversed(events):
+        kind = rec.get("event")
+        if kind == "drift_detected" and "step" in rec:
+            return ("drift_detected", int(rec["step"]))
+        if kind == "guard_decision" and "step" in rec:
+            decision = str(rec.get("decision", ""))
+            if decision.startswith(("spike_", "nonfinite_")):
+                return (decision, int(rec["step"]))
+    return None
+
+
+class FailureLedger:
+    """Per-death forensic record: exit code, classified reason, the mesh
+    the attempt ran on, the metrics events it appended, and the failure
+    signature — the thing the transient-vs-deterministic call is made
+    on.  Printed whenever the supervisor gives up."""
+
+    def __init__(self, metrics_path: Optional[str] = None):
+        self.metrics_path = metrics_path
+        self.deaths: List[dict] = []
+        self._offset = 0
+        self._sig_counts: dict = {}
+
+    def record_death(self, *, exit_code: int, reason: str,
+                     mesh: Optional[str], wall_s: float) -> dict:
+        events, self._offset = _iter_new_events(self.metrics_path,
+                                                self._offset)
+        sig = _failure_signature(events)
+        count = 0
+        if sig is not None:
+            count = self._sig_counts.get(sig, 0) + 1
+            self._sig_counts[sig] = count
+        entry = {
+            "death": len(self.deaths) + 1,
+            "exit_code": int(exit_code),
+            "reason": reason,
+            "mesh": mesh,
+            "wall_s": round(float(wall_s), 3),
+            "events": len(events),
+            "last_event": events[-1] if events else None,
+            "signature": sig,
+            "signature_count": count,
+        }
+        self.deaths.append(entry)
+        return entry
+
+    @staticmethod
+    def is_deterministic(entry: dict) -> bool:
+        """A crash whose signature has now been seen twice — spec'd as
+        exactly-2 so one recurrence is enough and budget stops burning."""
+        return (entry["reason"] == "crash"
+                and entry["signature"] is not None
+                and entry["signature_count"] >= 2)
+
+    def format(self) -> str:
+        lines = ["failure ledger "
+                 f"({self.metrics_path or 'no metrics stream'}):"]
+        if not self.deaths:
+            lines.append("  (no deaths recorded)")
+        for d in self.deaths:
+            last = d["last_event"]
+            last_txt = "-"
+            if last is not None:
+                step = last.get("step")
+                last_txt = str(last.get("event"))
+                if last.get("decision"):
+                    last_txt += f":{last['decision']}"
+                if step is not None:
+                    last_txt += f"@step={step}"
+            sig_txt = "-"
+            if d["signature"] is not None:
+                sig_txt = (f"{d['signature'][0]}@step={d['signature'][1]} "
+                           f"(x{d['signature_count']})")
+            lines.append(
+                f"  death {d['death']}: exit {d['exit_code']} "
+                f"({d['reason']}) mesh={d['mesh'] or '-'} "
+                f"wall={d['wall_s']:.1f}s last_event={last_txt} "
+                f"signature={sig_txt}")
+        return "\n".join(lines)
+
+
+# -- device probe ----------------------------------------------------------
+
+
+def probe_device_count(env: Optional[dict] = None,
+                       timeout: float = 120.0) -> Optional[int]:
+    """The live device count, from :data:`PROBE_ENV` when set (tests, CI)
+    or a throwaway interpreter otherwise (the supervisor itself must not
+    import jax — initializing a TPU runtime in the wrapper would hold the
+    very devices the child needs).  ``None`` when the probe fails: the
+    caller falls back to the full mesh and lets the child's own device
+    check report the shortage."""
+    env = dict(env if env is not None else os.environ)
+    override = env.get(PROBE_ENV)
+    if override:
+        try:
+            return max(1, int(override))
+        except ValueError:
+            return None
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.device_count())"],
+            env=env, capture_output=True, text=True, timeout=timeout)
+        return int(out.stdout.strip().splitlines()[-1])
+    except (subprocess.SubprocessError, OSError, ValueError, IndexError):
+        return None
+
+
+# -- the supervisor --------------------------------------------------------
+
+
+def _default_launcher(argv: Sequence[str], env: dict) -> int:
+    return subprocess.call(list(argv), env=env)
+
+
+class Supervisor:
+    """Launch ``child_argv``, classify its deaths, and relaunch with
+    ``--resume`` under a bounded budget.  Every collaborator with a side
+    effect (process launch, device probe, sleep, clock) is injectable so
+    the edge-case tests run in milliseconds without subprocesses."""
+
+    def __init__(self, child_argv: Sequence[str], *,
+                 max_restarts: int = 5,
+                 backoff_base: float = 1.0,
+                 backoff_max: float = 60.0,
+                 jitter: float = 0.25,
+                 seed: Optional[int] = None,
+                 keep_fault_env: bool = False,
+                 prom_path: Optional[str] = None,
+                 env: Optional[dict] = None,
+                 launcher: Optional[Callable] = None,
+                 device_probe: Optional[Callable] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry=None):
+        self.child_argv = list(child_argv)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.jitter = float(jitter)
+        self.keep_fault_env = bool(keep_fault_env)
+        self._rng = random.Random(seed)
+        self._base_env = dict(env if env is not None else os.environ)
+        self._launcher = launcher or _default_launcher
+        self._device_probe = device_probe or probe_device_count
+        self._sleep = sleep
+        self._clock = clock
+        # Full-mesh topology to grow back to, parsed once from the ORIGINAL
+        # argv (later relaunches rewrite the flags in place).
+        mesh = _get_flag(self.child_argv, "--mesh_shape")
+        self._full_mesh: Optional[Tuple[int, int]] = None
+        if mesh:
+            try:
+                d, m = (int(x) for x in mesh.split(","))
+                self._full_mesh = (d, m)
+            except ValueError:
+                pass
+        ndev = _get_flag(self.child_argv, "--num_devices")
+        self._full_num_devices = (int(ndev)
+                                  if ndev and ndev.isdigit() else None)
+        metrics_path = _get_flag(self.child_argv, "--metrics_path")
+        self.ledger = FailureLedger(metrics_path)
+        self.prom_path = prom_path or (
+            metrics_path + ".supervisor.prom" if metrics_path else None)
+        if registry is None:
+            from ..obs.registry import MetricsRegistry
+            registry = MetricsRegistry()
+        self.registry = registry
+        from ..obs.registry import SECONDS_BUCKETS
+        self._restarts_total = registry.counter(
+            "ddp_supervisor_restarts_total",
+            "Child relaunches by classified death reason", ("reason",))
+        self._recovery_seconds = registry.histogram(
+            "ddp_supervisor_recovery_seconds",
+            "Child death to relaunch, seconds (backoff + probe included)",
+            buckets=SECONDS_BUCKETS)
+        self.restarts_used = 0
+
+    # -- per-launch plumbing ----------------------------------------------
+
+    def _child_env(self, first_launch: bool) -> dict:
+        env = dict(self._base_env)
+        env[SUPERVISED_ENV] = "1"
+        if not first_launch and not self.keep_fault_env:
+            # A step/epoch-triggered DDP_TPU_FAULT would re-fire on the
+            # resumed run (the injectors count from the RESUMED host step,
+            # already past the trigger) and preempt it forever — injected
+            # faults are one drill each unless the campaign says otherwise.
+            env.pop("DDP_TPU_FAULT", None)
+        return env
+
+    def _relaunch_argv(self, argv: Sequence[str]) -> List[str]:
+        argv = _ensure_resume(argv)
+        if self._full_mesh is None and self._full_num_devices is None:
+            return argv  # no topology flags to manage
+        ndev = self._device_probe(self._child_env(first_launch=False))
+        if self._full_mesh is not None:
+            full_n = self._full_mesh[0] * self._full_mesh[1]
+            d, m = shrink_mesh(self._full_mesh,
+                               full_n if ndev is None else ndev)
+            if (d, m) != self._full_mesh:
+                print(f"[supervise] {ndev} device(s) live: shrinking mesh "
+                      f"{self._full_mesh[0]},{self._full_mesh[1]} -> "
+                      f"{d},{m} for this relaunch", file=sys.stderr)
+            argv = _set_flag(argv, "--mesh_shape", f"{d},{m}")
+        else:
+            want = self._full_num_devices
+            n = want if ndev is None else min(want, ndev)
+            argv = _set_flag(argv, "--num_devices", str(max(1, n)))
+        return argv
+
+    def _write_prom(self) -> None:
+        if not self.prom_path:
+            return
+        try:
+            with open(self.prom_path, "w") as f:
+                f.write(self.registry.exposition())
+        except OSError as e:
+            print(f"[supervise] WARNING: cannot write scrape file "
+                  f"{self.prom_path!r}: {e}", file=sys.stderr)
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self) -> int:
+        argv = list(self.child_argv)
+        first = True
+        backoff_no = 0  # escalates on stall/crash only, never preemption
+        while True:
+            mesh = (_get_flag(argv, "--mesh_shape")
+                    or _get_flag(argv, "--num_devices"))
+            print(f"[supervise] launching (attempt "
+                  f"{self.restarts_used + 1}/"
+                  f"{self.max_restarts + 1}): {' '.join(argv)}",
+                  file=sys.stderr)
+            sys.stderr.flush()
+            t0 = self._clock()
+            rc = self._launcher(argv, self._child_env(first))
+            wall = self._clock() - t0
+            if rc == 0:
+                print(f"[supervise] child completed after "
+                      f"{self.restarts_used} restart(s)", file=sys.stderr)
+                self._write_prom()
+                return 0
+            reason = classify_exit(rc)
+            entry = self.ledger.record_death(
+                exit_code=rc, reason=reason, mesh=mesh, wall_s=wall)
+            print(f"[supervise] child died: exit {rc} ({reason})",
+                  file=sys.stderr)
+            if FailureLedger.is_deterministic(entry):
+                sig = entry["signature"]
+                print(f"[supervise] DETERMINISTIC failure: "
+                      f"{sig[0]} at step {sig[1]} recurred "
+                      f"({entry['signature_count']} occurrences) — a "
+                      "poisoned step, not bad luck; refusing to burn the "
+                      "remaining restart budget", file=sys.stderr)
+                print(self.ledger.format(), file=sys.stderr)
+                self._write_prom()
+                return SUPERVISOR_DETERMINISTIC_EXIT_STATUS
+            if self.restarts_used >= self.max_restarts:
+                print(f"[supervise] restart budget exhausted "
+                      f"({self.max_restarts} restart(s) used); giving up — "
+                      "the newest verifiable checkpoint is still on disk "
+                      "for a manual relaunch", file=sys.stderr)
+                print(self.ledger.format(), file=sys.stderr)
+                self._write_prom()
+                return SUPERVISOR_BUDGET_EXIT_STATUS
+            t_dead = self._clock()
+            if reason == "preempted":
+                delay = 0.0  # checkpoint already on disk; relaunch now
+            else:
+                delay = backoff_delay(backoff_no, base=self.backoff_base,
+                                      cap=self.backoff_max,
+                                      jitter=self.jitter, rng=self._rng)
+                backoff_no += 1
+            if delay > 0:
+                print(f"[supervise] backing off {delay:.2f}s before "
+                      "relaunch", file=sys.stderr)
+                self._sleep(delay)
+            argv = self._relaunch_argv(argv)
+            self.restarts_used += 1
+            self._restarts_total.labels(reason=reason).inc()
+            # Death-to-relaunch recovery time: the wall clock covers the
+            # backoff sleep and the device probe; under an injected
+            # (instant) sleep the clock never moves, so the nominal delay
+            # is the floor.
+            self._recovery_seconds.observe(
+                max(delay, self._clock() - t_dead))
+            first = False
+
+
+# -- CLI -------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.supervise",
+        description="Restart wrapper for ddp_tpu training runs: relaunch "
+                    "with --resume on preemption (75) / stall (124) / "
+                    "transient crash, under a bounded backoff budget, "
+                    "with elastic mesh shrink-and-grow-back.",
+        epilog="Everything after `--` is the child command; a leading "
+               "*.py token is run under this interpreter.")
+    p.add_argument("--max_restarts", type=int, default=5,
+                   help="Restart budget (default 5); exhaustion exits "
+                        f"{SUPERVISOR_BUDGET_EXIT_STATUS}")
+    p.add_argument("--backoff_base", type=float, default=1.0,
+                   help="First stall/crash backoff in seconds (default 1); "
+                        "doubles per restart. Preemption never backs off.")
+    p.add_argument("--backoff_max", type=float, default=60.0,
+                   help="Backoff cap in seconds (default 60)")
+    p.add_argument("--jitter", type=float, default=0.25,
+                   help="Fractional backoff jitter (default 0.25)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="Jitter RNG seed (reproducible drills)")
+    p.add_argument("--prom", default=None, metavar="PATH",
+                   help="Supervisor metrics scrape file (default: "
+                        "<child --metrics_path>.supervisor.prom)")
+    p.add_argument("--keep_fault_env", action="store_true",
+                   help="Keep DDP_TPU_FAULT in relaunch environments "
+                        "(default: stripped after the first launch so a "
+                        "step-triggered fault is one drill, not a loop)")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--" in argv:
+        split = argv.index("--")
+        own, child = argv[:split], argv[split + 1:]
+    else:
+        own, child = argv, []
+    args = build_parser().parse_args(own)
+    if not child:
+        print("usage: python -m ddp_tpu.supervise [options] -- "
+              "<child command>", file=sys.stderr)
+        return 2
+    if child[0].endswith(".py") or child[0] == "-m":
+        child = [sys.executable] + child
+    sup = Supervisor(child, max_restarts=args.max_restarts,
+                     backoff_base=args.backoff_base,
+                     backoff_max=args.backoff_max, jitter=args.jitter,
+                     seed=args.seed, prom_path=args.prom,
+                     keep_fault_env=args.keep_fault_env)
+    return sup.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

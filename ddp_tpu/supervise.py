@@ -1,0 +1,8 @@
+"""``python -m ddp_tpu.supervise -- <training command>`` — the restart
+wrapper entry point.  All logic lives in resilience/supervisor.py; this
+module only exists so the wrapper is spelled the same way as the other
+executables (``-m ddp_tpu.serve``, ``-m ddp_tpu.analysis``)."""
+from .resilience.supervisor import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -208,6 +208,31 @@ def _params_equal(a, b):
                                       err_msg=str(pa))
 
 
+def test_fail_ckpt_write_surfaces_at_next_boundary_lineage_untorn(
+        tmp_path, monkeypatch):
+    """Checkpoint-write-failure drill (installed through the same
+    ``DDP_TPU_FAULT`` env path the subprocess drills use): the epoch-1
+    async write dies on the WRITER THREAD.  The deferred
+    ``trainer._save_error`` must surface at the next
+    ``_join_pending_save`` boundary — a silently-lost checkpoint must
+    not look saved — and the lineage must be left un-torn: the fault
+    fires before the head file is opened, so the newest verifiable
+    snapshot (the one ``--resume`` would restore) is still the clean
+    epoch-0 save, byte-intact."""
+    path = str(tmp_path / "ck.pt")
+    tr = _make_trainer(path, epochs=2, keep=2)
+    monkeypatch.setenv(faults.FAULT_ENV, "fail_ckpt_write@epoch=1")
+    faults.install_env_faults(tr)
+    with pytest.raises(OSError,
+                       match="injected checkpoint write failure"):
+        tr.train(2)
+    loaded = load_latest_verifiable(path)
+    assert loaded is not None
+    ckpt, used = loaded
+    assert int(ckpt.epoch) == 0  # the pre-fault save, byte-intact
+    assert int(ckpt.step) == len(tr.train_loader)
+
+
 def test_resume_falls_back_on_torn_head(tmp_path, capfd):
     """The acceptance drill: tear the head, resume must restore the
     previous retained snapshot with a logged warning and train on."""

@@ -1,0 +1,419 @@
+"""Run-supervisor tests (resilience/supervisor.py + the chaos campaign).
+
+The edge-case matrix runs against FAKE launchers/probes/sleeps — no
+subprocess, no jax in the child — so budget exhaustion, backoff bounds,
+deterministic-failure classification, and elastic shrink/grow-back are
+all tier-1-fast.  The end-to-end drills (a real training child killed by
+``sigterm@step`` / the watchdog, recovered under ``python -m
+ddp_tpu.supervise`` with bit-parity against an undisturbed control) run
+through tools/chaos_campaign.py and are marked slow.
+"""
+import importlib.util
+import json
+import os
+import random
+import sys
+import textwrap
+
+import pytest
+
+from ddp_tpu.resilience import faults
+from ddp_tpu.resilience.supervisor import (
+    PROBE_ENV, SUPERVISED_ENV, SUPERVISOR_BUDGET_EXIT_STATUS,
+    SUPERVISOR_DETERMINISTIC_EXIT_STATUS, FailureLedger, Supervisor,
+    _ensure_resume, _get_flag, _set_flag, backoff_delay, classify_exit,
+    shrink_mesh)
+from ddp_tpu.resilience.supervisor import main as supervise_main
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# -- pure helpers ----------------------------------------------------------
+
+
+def test_shrink_mesh_prefers_data_axis_then_model_divisors():
+    assert shrink_mesh((2, 4), 8) == (2, 4)   # everything alive: full mesh
+    assert shrink_mesh((2, 4), 7) == (1, 4)   # drop data replicas first
+    assert shrink_mesh((2, 4), 3) == (1, 2)   # then split M by a divisor
+    assert shrink_mesh((2, 4), 1) == (1, 1)
+    assert shrink_mesh((8, 1), 5) == (5, 1)
+    assert shrink_mesh((2, 4), 0) == (1, 1)   # clamped, never empty
+
+
+def test_classify_exit_contract():
+    assert classify_exit(75) == "preempted"
+    assert classify_exit(124) == "stalled"
+    assert classify_exit(1) == "crash"
+    assert classify_exit(-9) == "crash"  # signal death (subprocess style)
+
+
+def test_backoff_doubles_with_jitter_inside_bounds():
+    rng = random.Random(7)
+    base, cap, j = 0.5, 60.0, 0.25
+    for k in range(6):
+        nominal = min(base * 2 ** k, cap)
+        for _ in range(20):
+            d = backoff_delay(k, base=base, cap=cap, jitter=j, rng=rng)
+            assert nominal * (1 - j) <= d <= nominal * (1 + j)
+    # The cap holds even with jitter's headroom accounted for.
+    d = backoff_delay(50, base=base, cap=cap, jitter=j, rng=rng)
+    assert d <= cap * (1 + j)
+
+
+def test_argv_flag_helpers():
+    argv = ["prog.py", "3", "1", "--mesh_shape", "2,4", "--lr=0.05"]
+    assert _get_flag(argv, "--mesh_shape") == "2,4"
+    assert _get_flag(argv, "--lr") == "0.05"
+    assert _get_flag(argv, "--absent") is None
+    assert _set_flag(argv, "--mesh_shape", "1,4")[4] == "1,4"
+    assert "--lr=0.1" in _set_flag(argv, "--lr", "0.1")
+    appended = _set_flag(argv, "--seed", "3")
+    assert appended[-2:] == ["--seed", "3"]
+    assert _ensure_resume(argv)[-1] == "--resume"
+    assert _ensure_resume(appended + ["--resume"]).count("--resume") == 1
+
+
+# -- supervisor loop (fake launcher) ---------------------------------------
+
+
+class _FakeLauncher:
+    """Scripted child: pops the next exit code per launch, recording the
+    argv/env it was launched with; an optional hook runs per launch
+    (e.g. appending metrics events like a dying child would)."""
+
+    def __init__(self, codes, hook=None):
+        self.codes = list(codes)
+        self.launches = []
+        self.hook = hook
+
+    def __call__(self, argv, env):
+        self.launches.append((list(argv), dict(env)))
+        if self.hook:
+            self.hook(len(self.launches))
+        return self.codes.pop(0) if self.codes else 0
+
+
+def _sup(launcher, tmp_path, child=None, **kw):
+    kw.setdefault("backoff_base", 0.5)
+    kw.setdefault("jitter", 0.25)
+    kw.setdefault("seed", 0)
+    kw.setdefault("prom_path", str(tmp_path / "sup.prom"))
+    sleeps = []
+    sup = Supervisor(child or ["train.py", "--lr", "0.05"],
+                     launcher=launcher, sleep=sleeps.append,
+                     device_probe=lambda env: 8, **kw)
+    return sup, sleeps
+
+
+def test_clean_child_means_no_restarts(tmp_path):
+    launcher = _FakeLauncher([0])
+    sup, sleeps = _sup(launcher, tmp_path)
+    assert sup.run() == 0
+    assert len(launcher.launches) == 1 and sleeps == []
+    assert sup.restarts_used == 0
+    argv, env = launcher.launches[0]
+    assert "--resume" not in argv  # first launch is verbatim
+    assert env[SUPERVISED_ENV] == "1"
+    assert os.path.exists(sup.prom_path)
+
+
+def test_preemption_resumes_immediately_with_resume(tmp_path):
+    launcher = _FakeLauncher([75, 0])
+    sup, sleeps = _sup(launcher, tmp_path)
+    assert sup.run() == 0
+    assert sleeps == []  # no backoff: the checkpoint is already on disk
+    assert "--resume" in launcher.launches[1][0]
+    assert sup.restarts_used == 1
+    assert sup._restarts_total.labels(reason="preempted").value == 1
+
+
+def test_stall_and_crash_back_off_exponentially(tmp_path):
+    launcher = _FakeLauncher([124, 1, 1, 0])
+    sup, sleeps = _sup(launcher, tmp_path, max_restarts=5)
+    assert sup.run() == 0
+    assert len(sleeps) == 3
+    for k, s in enumerate(sleeps):
+        nominal = 0.5 * 2 ** k
+        assert nominal * 0.75 <= s <= nominal * 1.25
+    assert sup._restarts_total.labels(reason="stalled").value == 1
+    assert sup._restarts_total.labels(reason="crash").value == 2
+
+
+def test_budget_exhaustion_exits_86_with_ledger(tmp_path, capsys):
+    launcher = _FakeLauncher([1, 1, 1])
+    sup, _ = _sup(launcher, tmp_path, max_restarts=2)
+    assert sup.run() == SUPERVISOR_BUDGET_EXIT_STATUS
+    assert len(launcher.launches) == 3  # 1 launch + 2 restarts
+    err = capsys.readouterr().err
+    assert "restart budget exhausted" in err
+    assert "failure ledger" in err
+    assert err.count("death") >= 3
+
+
+def _metrics_hook(path, steps):
+    def hook(launch_no):
+        with open(path, "a") as f:
+            f.write(json.dumps({"event": "drift_detected",
+                                "step": steps[launch_no - 1],
+                                "action": "abort"}) + "\n")
+    return hook
+
+
+def test_deterministic_same_step_classified_after_exactly_2(tmp_path,
+                                                            capsys):
+    mpath = str(tmp_path / "metrics.jsonl")
+    launcher = _FakeLauncher([1, 1, 1, 1],
+                             hook=_metrics_hook(mpath, [5, 5, 5, 5]))
+    sup, _ = _sup(launcher, tmp_path, max_restarts=10,
+                  child=["train.py", "--metrics_path", mpath])
+    assert sup.run() == SUPERVISOR_DETERMINISTIC_EXIT_STATUS
+    # Exactly 2 occurrences: the second identical death stops the loop
+    # with 9 restarts of budget still unspent.
+    assert len(launcher.launches) == 2
+    err = capsys.readouterr().err
+    assert "DETERMINISTIC" in err
+    assert "drift_detected" in err and "step 5" in err
+
+
+def test_different_step_failures_stay_transient(tmp_path):
+    mpath = str(tmp_path / "metrics.jsonl")
+    launcher = _FakeLauncher([1, 1, 0],
+                             hook=_metrics_hook(mpath, [3, 5, 7]))
+    sup, _ = _sup(launcher, tmp_path, max_restarts=5,
+                  child=["train.py", "--metrics_path", mpath])
+    assert sup.run() == 0  # moving signature = transient, keep restarting
+    assert len(launcher.launches) == 3
+
+
+def test_elastic_shrink_then_growback_at_relaunch_boundary(tmp_path):
+    probes = iter([4, 8])
+    calls = []
+
+    def probe(env):
+        n = next(probes)
+        calls.append(n)
+        return n
+
+    launcher = _FakeLauncher([75, 75, 0])
+    sup = Supervisor(["train.py", "--mesh_shape", "2,4"],
+                     launcher=launcher, sleep=lambda s: None,
+                     device_probe=probe, seed=0,
+                     prom_path=str(tmp_path / "sup.prom"))
+    assert sup.run() == 0
+    assert _get_flag(launcher.launches[0][0], "--mesh_shape") == "2,4"
+    # 4 devices alive -> shrink; all 8 back -> grow to the full mesh.
+    assert _get_flag(launcher.launches[1][0], "--mesh_shape") == "1,4"
+    assert _get_flag(launcher.launches[2][0], "--mesh_shape") == "2,4"
+    # Probed exactly once per RELAUNCH (growth only ever happens at a
+    # relaunch boundary — there is nothing to probe for a running child).
+    assert calls == [4, 8]
+
+
+def test_fault_env_is_stripped_on_relaunch(tmp_path):
+    env = dict(os.environ)
+    env["DDP_TPU_FAULT"] = "sigterm@step=2"
+    launcher = _FakeLauncher([75, 0])
+    sup = Supervisor(["train.py"], launcher=launcher, env=env,
+                     sleep=lambda s: None, device_probe=lambda e: 8,
+                     seed=0)
+    assert sup.run() == 0
+    assert launcher.launches[0][1].get("DDP_TPU_FAULT") == "sigterm@step=2"
+    assert "DDP_TPU_FAULT" not in launcher.launches[1][1]
+    # --keep_fault_env opts back in (campaigns that want a repeat fault).
+    launcher2 = _FakeLauncher([75, 0])
+    sup2 = Supervisor(["train.py"], launcher=launcher2, env=env,
+                      sleep=lambda s: None, device_probe=lambda e: 8,
+                      seed=0, keep_fault_env=True)
+    assert sup2.run() == 0
+    assert launcher2.launches[1][1].get("DDP_TPU_FAULT") == \
+        "sigterm@step=2"
+
+
+def test_supervisor_prom_exposes_restart_counters(tmp_path):
+    launcher = _FakeLauncher([75, 124, 0])
+    sup, _ = _sup(launcher, tmp_path)
+    assert sup.run() == 0
+    from ddp_tpu.obs.registry import parse_exposition
+    with open(sup.prom_path) as f:
+        fams = parse_exposition(f.read())
+    samples = fams["ddp_supervisor_restarts_total"]["samples"]
+    assert samples[("ddp_supervisor_restarts_total",
+                    (("reason", "preempted"),))] == 1
+    assert samples[("ddp_supervisor_restarts_total",
+                    (("reason", "stalled"),))] == 1
+    hist = fams["ddp_supervisor_recovery_seconds"]["samples"]
+    assert hist[("ddp_supervisor_recovery_seconds_count", ())] == 2
+
+
+def test_ledger_reads_only_new_events_per_death(tmp_path):
+    mpath = str(tmp_path / "m.jsonl")
+    led = FailureLedger(mpath)
+    with open(mpath, "w") as f:
+        f.write(json.dumps({"event": "guard_decision",
+                            "decision": "spike_abort", "step": 9}) + "\n")
+    e1 = led.record_death(exit_code=1, reason="crash", mesh="8,1",
+                          wall_s=1.0)
+    assert e1["signature"] == ("spike_abort", 9)
+    assert e1["signature_count"] == 1
+    # No new lines since: the next death has NO signature (the old event
+    # must not be re-counted — that would fake a deterministic verdict).
+    e2 = led.record_death(exit_code=1, reason="crash", mesh="8,1",
+                          wall_s=1.0)
+    assert e2["signature"] is None
+    assert not FailureLedger.is_deterministic(e2)
+
+
+def test_supervise_main_requires_child_command(capsys):
+    assert supervise_main([]) == 2
+    assert "usage" in capsys.readouterr().err
+
+
+def test_supervisor_with_real_stub_subprocess(tmp_path):
+    """Default launcher, real child processes: exit 75 once (state file
+    latch), then 0 — the no-jax end-to-end of the restart loop."""
+    stub = tmp_path / "stub.py"
+    stub.write_text(textwrap.dedent("""
+        import os, sys
+        state = sys.argv[1]
+        if not os.path.exists(state):
+            open(state, "w").write("first\\n")
+            sys.exit(75)
+        open(state, "a").write("resumed:" + ",".join(sys.argv[2:]))
+        sys.exit(0)
+    """))
+    state = tmp_path / "state.txt"
+    env = dict(os.environ)
+    env[PROBE_ENV] = "8"  # probe override: no jax-import subprocess
+    sup = Supervisor([sys.executable, str(stub), str(state),
+                      "--mesh_shape", "8,1"], seed=0, env=env,
+                     prom_path=str(tmp_path / "sup.prom"))
+    assert sup.run() == 0
+    content = state.read_text()
+    assert content.startswith("first")
+    assert "--resume" in content  # the relaunch carried the resume flag
+    assert "8,1" in content  # probe saw every device: mesh kept full
+    assert sup.restarts_used == 1
+
+
+# -- satellite: unknown DDP_TPU_FAULT kinds fail loudly, both sides --------
+
+
+def test_unknown_train_fault_kind_raises_named_valueerror(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "bogus@x=1")
+    with pytest.raises(ValueError,
+                       match="unknown DDP_TPU_FAULT fault kind 'bogus'"):
+        faults.install_env_faults(object())
+
+
+def test_unknown_serve_fault_kind_raises_named_valueerror(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "bogus@x=1")
+    with pytest.raises(
+            ValueError,
+            match="unknown DDP_TPU_FAULT serve fault kind 'bogus'"):
+        faults.install_serve_faults(object())
+
+
+# -- bench_trend ignores chaos scorecards ----------------------------------
+
+
+def test_bench_trend_ignores_chaos_files(tmp_path, monkeypatch, capsys):
+    bench_trend = _load_tool("bench_trend")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"metric": "train throughput (cpu)",
+                    "value": 100.0, "unit": "samples/sec"}}))
+    (tmp_path / "CHAOS_r01.json").write_text(json.dumps(
+        {"schema": "chaos_campaign/1", "verdict": "PASS",
+         "drills": {"sigterm_step": {"pass": True}}}))
+    monkeypatch.chdir(tmp_path)
+    assert bench_trend.main(["--glob", "*_r*.json"]) == 0
+    out = capsys.readouterr()
+    assert "ignoring 1 CHAOS_* scorecard(s)" in out.err
+    assert "chaos" not in out.out.lower()  # no bogus metric family
+
+
+# -- chaos campaign plumbing (no training subprocesses) --------------------
+
+
+def test_chaos_campaign_reads_supervisor_prom(tmp_path):
+    chaos = _load_tool("chaos_campaign")
+    from ddp_tpu.obs.registry import SECONDS_BUCKETS, MetricsRegistry
+    reg = MetricsRegistry()
+    reg.counter("ddp_supervisor_restarts_total", "", ("reason",)) \
+        .labels(reason="preempted").inc()
+    reg.histogram("ddp_supervisor_recovery_seconds", "",
+                  buckets=SECONDS_BUCKETS).observe(1.5)
+    with open(tmp_path / "metrics.jsonl.supervisor.prom", "w") as f:
+        f.write(reg.exposition())
+    stats = chaos._supervisor_stats(str(tmp_path))
+    assert stats["restarts"] == 1
+    assert stats["restart_reasons"] == {"preempted": 1}
+    assert stats["recovery_seconds_sum"] == 1.5
+    # A drill whose supervisor never wrote a scrape reads as 0 restarts.
+    empty = chaos._supervisor_stats(str(tmp_path / "nope"))
+    assert empty["restarts"] == 0
+
+
+def test_chaos_campaign_rejects_unknown_drill(tmp_path):
+    chaos = _load_tool("chaos_campaign")
+    with pytest.raises(SystemExit):
+        chaos.main(["--drills", "nope", "--out",
+                    str(tmp_path / "c.json")])
+
+
+# -- end-to-end drills (slow: real training children) ----------------------
+
+
+@pytest.mark.slow
+def test_chaos_campaign_sigterm_and_watchdog_recover_bit_identical(
+        tmp_path):
+    """The ISSUE acceptance drill: a run killed by ``sigterm@step`` AND
+    one killed by the watchdog both recover under ``python -m
+    ddp_tpu.supervise`` with zero operator input, and each resumed final
+    state is bit-for-bit identical to the undisturbed control."""
+    chaos = _load_tool("chaos_campaign")
+    out = tmp_path / "CHAOS_test.json"
+    rc = chaos.main(["--drills", "sigterm_step,watchdog_stall",
+                     "--workdir", str(tmp_path / "work"), "--keep",
+                     "--out", str(out), "--timeout", "420"])
+    card = json.loads(out.read_text())
+    assert rc == 0, card
+    assert card["verdict"] == "PASS"
+    sig = card["drills"]["sigterm_step"]
+    assert sig["supervisor_exit"] == 0
+    assert sig["restart_reasons"] == {"preempted": 1}
+    assert sig["bit_identical"] and sig["zero_data_loss"]
+    dog = card["drills"]["watchdog_stall"]
+    assert dog["supervisor_exit"] == 0
+    assert dog["restart_reasons"] == {"stalled": 1}
+    assert dog["bit_identical"] and dog["zero_data_loss"]
+
+
+@pytest.mark.slow
+def test_chaos_campaign_crash_classified_drills_recover(tmp_path):
+    """The crash half of the matrix: drift abort (SDC) and guard
+    spike_abort (poisoned batch) both die with exit 1, get classified
+    transient (the fault env is stripped on relaunch), and replay to the
+    control's exact bytes; the torn data_state resume degrades to the
+    epoch boundary and still matches."""
+    chaos = _load_tool("chaos_campaign")
+    out = tmp_path / "CHAOS_test.json"
+    rc = chaos.main(["--drills",
+                     "flip_param_bit,poison_batch,torn_data_state",
+                     "--workdir", str(tmp_path / "work"), "--keep",
+                     "--out", str(out), "--timeout", "420"])
+    card = json.loads(out.read_text())
+    assert rc == 0, card
+    assert card["verdict"] == "PASS"
+    assert card["drills"]["flip_param_bit"]["restart_reasons"] == \
+        {"crash": 1}
+    assert card["drills"]["poison_batch"]["restart_reasons"] == \
+        {"crash": 1}
+    assert card["drills"]["torn_data_state"]["restarts"] == 0

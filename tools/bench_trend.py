@@ -193,6 +193,17 @@ def main(argv: Optional[list] = None) -> int:
                         "stays advisory)")
     args = p.parse_args(argv)
     paths = sorted(glob.glob(args.glob), key=_round_no)
+    # Chaos scorecards (tools/chaos_campaign.py) live next to the bench
+    # records and match sloppy globs like '*_r*.json', but they hold
+    # pass/fail drill verdicts, not metric trajectories — mixing them in
+    # would invent bogus families.
+    chaos = [p for p in paths
+             if os.path.basename(p).startswith("CHAOS_")]
+    if chaos:
+        print(f"ignoring {len(chaos)} CHAOS_* scorecard(s): "
+              + ", ".join(os.path.basename(p) for p in chaos),
+              file=sys.stderr)
+        paths = [p for p in paths if p not in chaos]
     if not paths:
         print(f"no files match {args.glob!r} — nothing to compare",
               file=sys.stderr)
