@@ -47,7 +47,8 @@ from .findings import Finding, make_finding
 SCAN_MODULES = ("data/prefetch.py", "serve/batcher.py", "serve/engine.py",
                 "serve/router.py", "serve/fleet.py",
                 "train/trainer.py", "train/checkpoint.py",
-                "resilience/watchdog.py", "obs/registry.py")
+                "resilience/watchdog.py", "resilience/store.py",
+                "obs/registry.py")
 
 _ANN_RE = re.compile(
     r"#\s*analysis:\s*(shared-under|unlocked-ok)\(([^)]*)\)")

@@ -213,6 +213,22 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "the newest verifiable one when the head is torn. "
                         "Default 1 = head only, the reference's "
                         "overwrite-in-place (multigpu.py:111)")
+    p.add_argument("--mirror", default=None, metavar="URI",
+                   help="Second checkpoint durability tier "
+                        "(resilience/store.py): asynchronously mirror "
+                        "every committed checkpoint to this object-store "
+                        "URI — a directory path (or dir://PATH) runs the "
+                        "bundled DirStore backend; gs://-style schemes "
+                        "name the CheckpointStore paste point (RUNBOOK "
+                        "§18).  Uploads run on a background thread AFTER "
+                        "each lineage commit with per-op timeouts and "
+                        "bounded jittered retries: a flaky or dead remote "
+                        "degrades to a visible ddp_mirror_lag_epochs "
+                        "gauge, never a blocked or failed step.  --resume "
+                        "falls back to verifiable mirror objects when "
+                        "every local candidate is gone — training "
+                        "survives total local-disk loss (the supervisor "
+                        "preserves this flag across relaunches)")
     p.add_argument("--on_nan", default="abort",
                    choices=["abort", "skip", "restore"],
                    help="Non-finite loss policy, checked on the existing "
@@ -748,6 +764,11 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                 f"drift audit: "
                 + (f"last at step {drift.last_audit_step}"
                    if drift is not None else "off"))
+            mirror = getattr(t, "_mirror", None)
+            parts.append(
+                "mirror: "
+                + (f"lag {mirror.lag_epochs()} epoch(s)"
+                   if mirror is not None else "off"))
         return "\n".join(p for p in parts if p)
 
     watchdog = (Watchdog(args.watchdog_secs,
@@ -820,7 +841,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                                                  "guard_spike_factor", 0.0),
                       guard_action=getattr(args, "guard_action",
                                            "rollback"),
-                      registry=registry)
+                      registry=registry,
+                      mirror=getattr(args, "mirror", None))
     trainer_ref.append(trainer)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in

@@ -39,7 +39,7 @@ from .export import PHASE_ORDER
 # named its "straggler" every save epoch, burying real skew.  The phase
 # stays in per-host reports and bench phase_ms; it just cannot be
 # compared ACROSS hosts.
-STRAGGLER_EXCLUDED_PHASES = frozenset(("ckpt_write",))
+STRAGGLER_EXCLUDED_PHASES = frozenset(("ckpt_write", "ckpt_upload"))
 
 
 def phase_medians(spans: List[dict],

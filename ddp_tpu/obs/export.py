@@ -35,7 +35,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 # with the training pipeline).  Unknown phases sort after these (the
 # tracer accepts free-form names).
 PHASE_ORDER = ("data_wait", "host_augment", "h2d", "dispatch",
-               "loss_flush", "drift_audit", "ckpt_write", "eval",
+               "loss_flush", "drift_audit", "ckpt_write", "ckpt_upload",
+               "eval",
                "queue_wait", "batch_form", "pad", "forward", "d2h",
                # Fleet/router phases (serve/router.py, serve/fleet.py):
                # route/retry are per-request handler-thread spans

@@ -27,6 +27,15 @@ Faults:
                         WRITER THREAD (a full disk / lost mount) — drives
                         the deferred ``trainer._save_error`` surfacing at
                         the next join, with the lineage left un-torn
+  ``fail_put``          the next n mirror uploads fail at the store — the
+                        flaky remote the uploader's backoff absorbs
+  ``slow_put``          every mirror upload stalls ms at the store — the
+                        hung remote the per-op deadline bounds (training
+                        keeps stepping; mirror lag grows visibly)
+  ``torn_remote_object``  the next mirror upload lands truncated under a
+                        full-length sha — restore must detect + fall back
+  ``wipe_local_ckpt``   delete every local lineage file after epoch k has
+                        mirrored — total local-disk loss, mirror-only copy
 
 Serve-side faults (the fleet chaos drills — tests/test_fleet.py and the
 CI fleet smoke):
@@ -45,7 +54,9 @@ Env surface for subprocess drills (``DDP_TPU_FAULT``): semicolon-separated
 specs ``kind@key=val,key=val`` — e.g.
 ``sigterm@epoch=1``, ``sigterm@step=12``, ``poison@step=5``,
 ``flip_param_bit@step=6,replica=1``, ``poison_batch@step=9,scale=1e4``,
-``stall@epoch=0,rank=1,secs=600``, ``fail_ckpt_write@epoch=1``.  Serve processes
+``stall@epoch=0,rank=1,secs=600``, ``fail_ckpt_write@epoch=1``,
+``fail_put@n=2``, ``slow_put@ms=500``, ``torn_remote_object@``,
+``wipe_local_ckpt@epoch=1``.  Serve processes
 (``python -m ddp_tpu.serve --fleet N``) parse the same variable through
 :func:`install_serve_faults` with the serve vocabulary:
 ``crash_replica@requests=25,replica=0``, ``slow_forward@ms=200,replica=1``,
@@ -376,6 +387,106 @@ def torn_publish(fleet) -> None:
     fleet._load_snapshot = wrapped
 
 
+def _known_kwargs(kind: str, part: str, kv: dict, allowed) -> None:
+    """Strict kwarg validation for the mirror-era fault forms: a typo'd
+    key must fail the drill loudly at install time, not silently arm
+    nothing (matching the unknown-kind contract below)."""
+    unknown = sorted(set(kv) - set(allowed))
+    if unknown:
+        raise ValueError(
+            f"unknown kwarg(s) {unknown} for {FAULT_ENV} fault {kind!r} "
+            f"in {part!r} (allowed: {sorted(allowed)})")
+
+
+def _mirror_store_of(trainer):
+    """The trainer's DirStore mirror backend, for fault injection — the
+    flaky-remote faults are meaningless (and a drill wiring error)
+    without ``--mirror``."""
+    store = getattr(trainer, "_mirror_store", None)
+    if store is None or not hasattr(store, "inject_fail_puts"):
+        raise ValueError(
+            f"{FAULT_ENV} mirror fault needs a trainer running with "
+            "--mirror over a DirStore backend (no store to inject into)")
+    return store
+
+
+def fail_put(trainer, n) -> None:
+    """The next ``n`` mirror uploads fail at the store — a flaky remote;
+    the uploader's bounded backoff retries must absorb it (or degrade to
+    visible mirror lag), never the training loop."""
+    n = int(n)
+    if n < 1:
+        raise ValueError(f"fail_put: n must be >= 1, got {n}")
+    _mirror_store_of(trainer).inject_fail_puts(n)
+    print(f"[fault] next {n} mirror put(s) will fail", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def slow_put(trainer, ms) -> None:
+    """Every mirror upload stalls ``ms`` milliseconds at the store — the
+    hung-remote model; the per-op deadline times it out and training
+    must keep stepping while ``mirror_lag_epochs`` grows."""
+    ms = float(ms)
+    if ms < 0:
+        raise ValueError(f"slow_put: ms must be >= 0, got {ms:g}")
+    _mirror_store_of(trainer).inject_slow_put(ms / 1e3)
+    print(f"[fault] mirror puts slowed by {ms:g} ms", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def torn_remote_object(trainer) -> None:
+    """The next mirror upload lands TRUNCATED while the store records the
+    full-length sha — the lie a torn network upload tells.  The mirror
+    restore walk must detect the mismatch at get time and fall back to
+    the next mirrored object."""
+    _mirror_store_of(trainer).inject_torn_next_put()
+    print("[fault] next mirror put will land torn", file=sys.stderr)
+    sys.stderr.flush()
+
+
+def wipe_local_ckpt(trainer, epoch) -> None:
+    """Delete EVERY local checkpoint lineage file (head, manifest,
+    rotated snapshots, shard files) after epoch ``epoch``'s checkpoint
+    has committed and mirrored — total local-disk loss with the mirror
+    as the only surviving copy.  Fires at the start of the next epoch
+    (so the wiped epoch's save + mirror upload have landed); later saves
+    recreate the head, and a relaunch restores from the mirror."""
+    epoch = int(epoch)
+    if epoch < 0:
+        raise ValueError(f"wipe_local_ckpt: epoch must be >= 0, "
+                         f"got {epoch}")
+    path = getattr(trainer, "snapshot_path", None)
+    if not path:
+        raise ValueError("wipe_local_ckpt needs a trainer with a "
+                         "snapshot path (nothing local to wipe)")
+    orig = trainer._run_epoch
+    fired = [False]
+
+    def wrapped(ep, *a, **kw):
+        if not fired[0] and ep > epoch:
+            fired[0] = True
+            trainer._join_pending_save()
+            drain = getattr(trainer, "_mirror_drain", None)
+            if drain is not None:
+                drain(60.0)
+            d = os.path.dirname(os.path.abspath(path))
+            base = os.path.basename(path)
+            victims = [f for f in os.listdir(d)
+                       if f == base or f.startswith(base + ".")]
+            for v in victims:
+                try:
+                    os.unlink(os.path.join(d, v))
+                except OSError:
+                    pass
+            print(f"[fault] wiped {len(victims)} local checkpoint "
+                  f"file(s) under {d!r} after epoch {epoch} — the "
+                  "mirror is the only copy now", file=sys.stderr)
+            sys.stderr.flush()
+        return orig(ep, *a, **kw)
+
+    trainer._run_epoch = wrapped
+
+
 def install_serve_faults(fleet) -> None:
     """Apply :data:`FAULT_ENV` serve-fault specs to ``fleet`` (the serve
     process's counterpart of :func:`install_env_faults`; no-op when the
@@ -440,6 +551,18 @@ def install_env_faults(trainer) -> None:
                            rank=int(kv["rank"]) if "rank" in kv else None)
         elif kind == "fail_ckpt_write":
             fail_ckpt_write(trainer, int(kv["epoch"]))
+        elif kind == "fail_put":
+            _known_kwargs(kind, part, kv, ("n",))
+            fail_put(trainer, kv.get("n", "1"))
+        elif kind == "slow_put":
+            _known_kwargs(kind, part, kv, ("ms",))
+            slow_put(trainer, kv["ms"])
+        elif kind == "torn_remote_object":
+            _known_kwargs(kind, part, kv, ())
+            torn_remote_object(trainer)
+        elif kind == "wipe_local_ckpt":
+            _known_kwargs(kind, part, kv, ("epoch",))
+            wipe_local_ckpt(trainer, kv["epoch"])
         else:
             raise ValueError(f"unknown {FAULT_ENV} fault kind {kind!r} "
                              f"in {part!r}")

@@ -61,6 +61,21 @@ def _log(msg: str) -> None:
     sys.stderr.flush()
 
 
+def _fsync_dir(d: str) -> None:
+    """Durable-rename helper: fsync a directory, tolerating platforms
+    (and filesystems) where directories cannot be fsynced."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def read_manifest(path: str) -> Optional[Dict[str, Any]]:
     """The head path's manifest, or None when absent/unparseable (a torn
     manifest is logged and treated as missing — the files themselves are
@@ -88,6 +103,13 @@ class CheckpointLineage:
         self.path = path
         self.keep = int(keep)
         self.manifest_path = path + MANIFEST_SUFFIX
+        # Tier hook: when a mirror uploader is attached (store.py), commit
+        # stamps each entry's mirror status ("pending"/"mirrored") via this
+        # epoch -> status callable.  Set once before the writer thread
+        # starts and only CALLED from it, so manifest writes stay on the
+        # single writer.  None = no mirror tier (status keys absent; old
+        # manifests and mirror-less runs are byte-identical to before).
+        self.mirror_state = None
 
     # -- write side (single writer thread) --------------------------------
 
@@ -187,6 +209,11 @@ class CheckpointLineage:
             new_shards |= set(_entry_shards(e))
         for fname in sorted(old_shards - new_shards):
             self._unlink_shard(fname)
+        if self.mirror_state is not None:
+            head["mirror"] = self.mirror_state(int(epoch))
+            for e in retained:
+                if e.get("mirror") != "mirrored" and "epoch" in e:
+                    e["mirror"] = self.mirror_state(int(e["epoch"]))
         manifest = {
             "format": MANIFEST_FORMAT,
             "head": head,
@@ -195,9 +222,17 @@ class CheckpointLineage:
         d = os.path.dirname(os.path.abspath(self.manifest_path))
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
         try:
+            # Crash-atomic: fsync the bytes BEFORE the rename publishes
+            # them (or power loss can promote an empty manifest over a
+            # good one), and fsync the directory AFTER so the rename
+            # itself is durable — rename ordering alone is a filesystem
+            # implementation detail, not a guarantee.
             with os.fdopen(fd, "w") as f:
                 json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.manifest_path)
+            _fsync_dir(d)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
@@ -297,9 +332,77 @@ def _resolve_head(path: str) -> str:
     return os.path.join(path, "checkpoint.pt")
 
 
+def _restore_from_mirror(path: str, loader, store,
+                         tried: List[Tuple[str, str]]
+                         ) -> Optional[Tuple[Checkpoint, str]]:
+    """Tier-2 fallback of :func:`latest_verifiable`: download verifiable
+    mirror objects (head first, then retained, newest first) back into
+    the local checkpoint directory — recreating it when the whole local
+    disk is gone — and load them with the SAME loader/fallback semantics
+    as the local walk.  Both formats restore: a gathered v1 head is one
+    object; a sharded v2 entry downloads its index plus every shard file
+    the mirror manifest lists.  Failures append to ``tried`` (the raise
+    in the caller names every candidate, both tiers)."""
+    base = os.path.basename(path)
+    d = os.path.dirname(os.path.abspath(path))
+    mname = base + MANIFEST_SUFFIX
+    try:
+        if store.stat(mname) is None:
+            return None  # nothing ever mirrored — not an error
+        rm = json.loads(store.get_bytes(mname).decode())
+    except Exception as e:  # noqa: BLE001 — any store/parse damage
+        tried.append((f"<mirror>/{mname}",
+                      f"mirror manifest unreadable ({e})"))
+        _log(f"WARNING: mirror manifest {mname!r} in {store.describe()} "
+             f"is unreadable ({e}); no mirror fallback")
+        return None
+    if not isinstance(rm, dict):
+        tried.append((f"<mirror>/{mname}", "mirror manifest malformed"))
+        return None
+    entries = [rm.get("head")] + list(rm.get("retained") or [])
+    for e in entries:
+        if not isinstance(e, dict) or not e.get("file"):
+            continue
+        fname = str(e["file"])
+        local = os.path.join(d, fname)
+        try:
+            os.makedirs(d, exist_ok=True)
+            store.get(fname, local)
+            for s in _entry_shards(e):
+                store.get(s, os.path.join(d, s))
+        except Exception as ex:  # noqa: BLE001 — skip to older object
+            tried.append((f"<mirror>/{fname}", str(ex)))
+            _log(f"WARNING: mirror object {fname!r} is not restorable "
+                 f"({ex}); falling back to the next mirrored snapshot")
+            continue
+        expected = e.get("sha256")
+        if expected:
+            try:
+                actual = sha256_of_file(local)
+            except OSError as ex:
+                tried.append((f"<mirror>/{fname}", f"unreadable ({ex})"))
+                continue
+            if actual != expected:
+                _log(f"WARNING: downloaded mirror object {fname!r} "
+                     "sha256 mismatch vs mirror manifest; attempting "
+                     "restore anyway")
+        try:
+            ck = loader(local)
+        except (FileNotFoundError, CheckpointError) as ex:
+            tried.append((f"<mirror>/{fname}", str(ex)))
+            _log(f"WARNING: mirror object {fname!r} downloaded but does "
+                 f"not restore ({ex}); falling back")
+            continue
+        _log(f"WARNING: restored checkpoint from MIRROR object {fname!r} "
+             f"(epoch {ck.epoch}) via {store.describe()} — no local "
+             f"candidate under {path!r} was verifiable")
+        return ck, local
+    return None
+
+
 def latest_verifiable(
         path: Optional[str],
-        loader=None) -> Optional[Tuple[Checkpoint, str]]:
+        loader=None, store=None) -> Optional[Tuple[Checkpoint, str]]:
     """Restore the newest verifiable checkpoint under ``path`` — the ONE
     manifest-walking selection both the trainer's resume and the serve
     engine's model load go through (a head checkpoint path, or a
@@ -322,9 +425,17 @@ def latest_verifiable(
     semantics: a loader must raise :class:`CheckpointError` for a
     candidate that cannot restore.
 
+    ``store`` (a ``resilience.store.CheckpointStore``) adds the second
+    durability tier: when every LOCAL candidate fails — or the local
+    directory is gone entirely — the walk falls back to verifiable
+    mirror objects via :func:`_restore_from_mirror`, downloading them
+    back into place so the run continues exactly as a local restore
+    would.  Local candidates always win when verifiable (they are never
+    older than the mirror, which only uploads committed states).
+
     Returns ``(checkpoint, file_used)``; ``None`` when no candidate exists
     at all (fresh training); raises :class:`CheckpointError` naming every
-    candidate tried when candidates exist but none restores.
+    candidate tried (both tiers) when candidates exist but none restores.
     """
     if not path:
         return None
@@ -359,7 +470,11 @@ def latest_verifiable(
                  f"(epoch {ck.epoch}) — the head {path!r} was torn or "
                  "missing")
         return ck, fp
-    if not cands:
+    if store is not None:
+        got = _restore_from_mirror(path, loader, store, tried)
+        if got is not None:
+            return got
+    if not cands and not tried:
         return None
     raise CheckpointError(
         f"no verifiable checkpoint under {path!r}; candidates tried: "

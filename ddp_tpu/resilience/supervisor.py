@@ -396,6 +396,57 @@ class Supervisor:
             print(f"[supervise] WARNING: cannot write scrape file "
                   f"{self.prom_path!r}: {e}", file=sys.stderr)
 
+    def _write_diagnosis(self, entry: dict) -> Optional[str]:
+        """Exit-87 repro artifact: ``diagnosis.json`` next to the ledger's
+        metrics stream.  A DETERMINISTIC verdict means a specific step
+        poisons the run every time — this file pins everything needed to
+        reproduce it after the fact: the failure signature (what + step +
+        occurrences), the checkpoint the relaunches restored from (head
+        ref incl. ``data_state`` and mirror status), the mirror URI, and
+        the last guard/drift event of every death."""
+        base = (os.path.dirname(os.path.abspath(self.ledger.metrics_path))
+                if self.ledger.metrics_path else os.getcwd())
+        path = os.path.join(base, "diagnosis.json")
+        sig = entry.get("signature") or (None, None)
+        snapshot = _get_flag(self.child_argv, "--snapshot_path")
+        ckpt: Optional[dict] = None
+        if snapshot:
+            head = None
+            try:
+                from .lineage import read_manifest
+                m = read_manifest(snapshot)
+                if m is not None and isinstance(m.get("head"), dict):
+                    head = m["head"]
+            except Exception:  # noqa: BLE001 — forensics must not crash
+                head = None
+            ckpt = {"path": snapshot, "head": head}
+        doc = {
+            "schema": "supervisor_diagnosis/1",
+            "verdict": "deterministic",
+            "signature": {"what": sig[0], "step": sig[1],
+                          "occurrences": entry.get("signature_count", 0)},
+            "exit_code": entry.get("exit_code"),
+            "mesh": entry.get("mesh"),
+            "checkpoint": ckpt,
+            "mirror": _get_flag(self.child_argv, "--mirror"),
+            "last_events": [d.get("last_event")
+                            for d in self.ledger.deaths],
+            "deaths": self.ledger.deaths,
+            "child_argv": list(self.child_argv),
+        }
+        try:
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1)
+            os.replace(tmp, path)
+        except OSError as e:
+            print(f"[supervise] WARNING: cannot write diagnosis "
+                  f"artifact {path!r}: {e}", file=sys.stderr)
+            return None
+        print(f"[supervise] diagnosis artifact written to {path}",
+              file=sys.stderr)
+        return path
+
     # -- the loop ----------------------------------------------------------
 
     def run(self) -> int:
@@ -431,6 +482,7 @@ class Supervisor:
                       "poisoned step, not bad luck; refusing to burn the "
                       "remaining restart budget", file=sys.stderr)
                 print(self.ledger.format(), file=sys.stderr)
+                self._write_diagnosis(entry)
                 self._write_prom()
                 return SUPERVISOR_DETERMINISTIC_EXIT_STATUS
             if self.restarts_used >= self.max_restarts:

@@ -270,6 +270,9 @@ def _save_sharded_body(path, params, batch_stats, opt_state, step, epoch,
 
 
 def _write_sidecar(fpath: str, sha: str, *, step: int, epoch: int) -> None:
+    # Same wiped-directory resilience as write_npz_hashed: recreate the
+    # checkpoint dir rather than dying between shard and sidecar.
+    os.makedirs(os.path.dirname(os.path.abspath(fpath)), exist_ok=True)
     tmp = f"{fpath}.sha256.tmp{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump({"sha256": sha, "step": int(step),

@@ -102,7 +102,8 @@ class Trainer:
                  guard_window: int = 64,
                  guard_spike_factor: float = 0.0,
                  guard_action: str = "rollback",
-                 registry=None):
+                 registry=None,
+                 mirror=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -137,6 +138,18 @@ class Trainer:
         self.lineage = (CheckpointLineage(snapshot_path,
                                           keep=keep_checkpoints)
                         if snapshot_path else None)
+        # Durability tier 2 (resilience/store.py): ``mirror`` is a store
+        # URI (or CheckpointStore) the committed lineage is asynchronously
+        # mirrored to, and the restore tier --resume falls back to when
+        # the whole local checkpoint directory is gone (preemption
+        # reclaims the VM's disk).  The store is resolved up front (the
+        # resume below may need it); the uploader thread itself starts
+        # later in __init__, after the tracer lands.
+        self._mirror = None
+        self._mirror_store = None
+        if mirror is not None and snapshot_path:
+            from ..resilience.store import open_store
+            self._mirror_store = open_store(mirror)
         self._health = StepHealthGuard(on_nan, window=guard_window,
                                        spike_factor=guard_spike_factor,
                                        spike_action=guard_action,
@@ -179,7 +192,8 @@ class Trainer:
             # pod that survived a preemption, leaf-streamed, never
             # gathered (elastic resume).
             loaded = latest_verifiable(snapshot_path,
-                                       loader=self._ckpt_loader())
+                                       loader=self._ckpt_loader(),
+                                       store=self._mirror_store)
             if loaded is not None:
                 ckpt, used = loaded
                 self.state = TrainState(
@@ -258,6 +272,18 @@ class Trainer:
         # rolling live-stats engine (rank 0, obs/live.py).
         self.tracer = tracer if tracer is not None else get_tracer()
         self._live = live if self.gpu_id == 0 else None
+        # Mirror uploader (rank 0 — the rank that commits lineage): one
+        # background thread, fed after each commit, strictly off the
+        # critical path.  Lineage manifests stamp each entry's mirror
+        # status through state_of_epoch.
+        if self._mirror_store is not None and self.gpu_id == 0:
+            from ..resilience.store import MirrorUploader
+            self._mirror = MirrorUploader(
+                self._mirror_store, snapshot_path,
+                keep=keep_checkpoints, registry=registry,
+                tracer=self.tracer)
+            if self.lineage is not None:
+                self.lineage.mirror_state = self._mirror.state_of_epoch
         if shard_update:
             # ZeRO-1-style weight-update sharding (train/zero.py): momentum
             # lives as one flat array sharded over ``data`` (1/R per chip;
@@ -592,6 +618,21 @@ class Trainer:
                     dist.abort()  # non-graceful: never blocks (dist.py)
                 raise err
 
+    def _mirror_drain(self, timeout: float = 30.0) -> None:
+        """Bounded wait for queued mirror uploads (emergency exits give
+        the remote copy a head start before the SIGKILL).  Degrades to a
+        logged lag report — NEVER raises, never waits unboundedly: the
+        local checkpoint is already durable at this point and the exit
+        contract (preemption status, supervisor relaunch) must hold even
+        with a dead remote."""
+        if self._mirror is None:
+            return
+        if not self._mirror.drain(timeout):
+            print(f"[GPU{self.gpu_id}] mirror: still "
+                  f"{self._mirror.lag_epochs()} epoch(s) behind after "
+                  f"{timeout:.0f}s drain window; newest state is "
+                  "local-only", file=sys.stderr)
+
     def _data_state(self, epoch: int, offset: int) -> dict:
         """The checkpoint's resume-position record: start training at
         batch ``offset`` of ``epoch`` (an end-of-epoch save is
@@ -721,6 +762,16 @@ class Trainer:
                     self.lineage.commit(epoch=epoch, step=step, sha256=sha,
                                         shards=shard_names,
                                         data_state=data_state)
+                if self._mirror is not None:
+                    # AFTER the commit: only durable, sha-recorded states
+                    # are mirrored.  enqueue snapshots the head (hard
+                    # link) and returns immediately — the upload itself
+                    # runs on the mirror's own thread, so a slow or dead
+                    # remote costs this writer (and the step loop) nothing.
+                    self._mirror.enqueue(epoch=epoch, step=step,
+                                         sha256=sha,
+                                         shards=shard_names or (),
+                                         data_state=data_state)
                 # Reference print, singlegpu.py:122.
                 print(f"Epoch {epoch} | Training checkpoint saved at "
                       f"{self.snapshot_path}")
@@ -744,7 +795,8 @@ class Trainer:
         self._pending_losses = None  # the poisoned trajectory's records
         self._preempt_pending = None
         loaded = (latest_verifiable(self.snapshot_path,
-                                    loader=self._ckpt_loader())
+                                    loader=self._ckpt_loader(),
+                                    store=self._mirror_store)
                   if self.snapshot_path else None)
         if loaded is None:
             raise NonFiniteLossError(
@@ -885,6 +937,7 @@ class Trainer:
         if self.snapshot_path and epoch % self.save_every != 0:
             self._save_checkpoint(epoch)  # the modulo gate didn't fire
         self._join_pending_save()  # async write must land before we exit
+        self._mirror_drain()  # bounded head start for the remote copy
         print(f"[GPU{self.gpu_id}] preemption: emergency checkpoint for "
               f"epoch {epoch} is on disk"
               + (f" at {self.snapshot_path}" if self.snapshot_path
@@ -919,6 +972,7 @@ class Trainer:
             self._save_checkpoint(epoch,
                                   data_state=self._data_state(epoch, k))
         self._join_pending_save()  # async write must land before we exit
+        self._mirror_drain()  # bounded head start for the remote copy
         print(f"[GPU{self.gpu_id}] preemption: mid-epoch emergency "
               f"checkpoint at epoch {epoch}, batch offset {k} (global "
               f"step {self._host_step})"
@@ -983,6 +1037,7 @@ class Trainer:
             # killed at interpreter exit and the newest checkpoint lost.
             if sys.exc_info()[1] is None:
                 self._join_pending_save()
+                self._mirror_drain()  # end-of-run: let the mirror catch up
             else:
                 # Already unwinding: still land the deferred losses and
                 # wait for the writer, but don't let THEIR errors REPLACE
@@ -998,3 +1053,4 @@ class Trainer:
                 except BaseException as e:
                     print(f"checkpoint write failed during shutdown: {e!r}",
                           file=sys.stderr)
+                self._mirror_drain(timeout=5.0)  # bounded, never raises

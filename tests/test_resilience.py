@@ -99,6 +99,45 @@ def test_lineage_rotation_manifest_and_fallback_order(tmp_path):
         assert name in str(ei.value)
 
 
+def test_manifest_commit_fsync_order_pins_crash_atomicity(tmp_path,
+                                                          monkeypatch):
+    """Satellite: the manifest commit must fsync the temp FILE before the
+    ``os.replace`` publish and fsync the DIRECTORY after it — rename
+    ordering alone is a filesystem implementation detail.  Pinned by (a)
+    recording the exact syscall order and (b) failing the pre-rename
+    fsync: the crash window must leave the previous manifest untouched."""
+    path = str(tmp_path / "ck.pt")
+    lin = CheckpointLineage(path, keep=2)
+    _commit(lin, 0)  # a known-good manifest on disk
+    lin.preserve_head()
+    sha = _write_ck(path, step=1, epoch=1)
+    calls = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(
+        os, "fsync",
+        lambda fd: (calls.append("fsync"), real_fsync(fd))[1])
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (calls.append("replace"), real_replace(a, b))[1])
+    lin.commit(epoch=1, step=1, sha256=sha)
+    assert calls == ["fsync", "replace", "fsync"]  # file, publish, dir
+    # ENOSPC at the pre-rename fsync: commit raises, the temp file is
+    # cleaned up, and the epoch-1 manifest survives byte-for-byte.
+    lin.preserve_head()
+    sha2 = _write_ck(path, step=2, epoch=2)
+
+    def _boom(fd):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(os, "fsync", _boom)
+    with pytest.raises(OSError, match="No space left"):
+        lin.commit(epoch=2, step=2, sha256=sha2)
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    m = json.load(open(path + ".manifest.json"))
+    assert m["head"]["epoch"] == 1  # the torn commit published NOTHING
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
 def test_lineage_keep1_is_head_only(tmp_path):
     """Default --keep_checkpoints 1 preserves today's artifact layout: one
     head file (plus the manifest), no rotated snapshots."""

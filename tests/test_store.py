@@ -1,0 +1,464 @@
+"""Durable checkpoint tiering (resilience/store.py): the object-store
+protocol, the DirStore remote stand-in's blob semantics (atomic
+visibility, meta-sidecar ordering, torn-upload detection), the retry/
+backoff policy bounds, the async MirrorUploader's degradation story
+(flaky remote -> visible lag, NEVER a blocked or failed step), the
+uploader-vs-rotation races, and the tier-aware
+``lineage.latest_verifiable`` fall-back (local first, then verifiable
+mirror objects — both the gathered v1 and sharded v2 formats).
+"""
+import json
+import os
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ddp_tpu.obs.registry import MetricsRegistry
+from ddp_tpu.optim.sgd import SGDState
+from ddp_tpu.resilience.lineage import (MANIFEST_SUFFIX, CheckpointLineage,
+                                        latest_verifiable, lineage_name)
+from ddp_tpu.resilience.store import (CheckpointStore, DirStore, LocalStore,
+                                      MirrorUploader, RetryPolicy,
+                                      StoreError, StoreTimeout, open_store)
+from ddp_tpu.train import load_checkpoint, save_checkpoint
+from ddp_tpu.train.checkpoint import CheckpointError, sha256_of_file
+
+
+def _write_ck(path, *, step, epoch):
+    """A tiny but structurally valid checkpoint; returns its sha."""
+    return save_checkpoint(
+        path, {"w": np.full(4, float(step), np.float32)}, {},
+        SGDState({"w": np.zeros(4, np.float32)}), step=step, epoch=epoch)
+
+
+def _fast_policy(retries=3):
+    return RetryPolicy(retries=retries, base=0.01, cap=0.05, jitter=0.25)
+
+
+def _mirrored_lineage(tmp_path, *, keep=2, registry=None, policy=None):
+    """A lineage + uploader pair wired the way the trainer wires them."""
+    path = str(tmp_path / "local" / "ck.npz")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    store = DirStore(str(tmp_path / "mirror"))
+    lin = CheckpointLineage(path, keep=keep)
+    up = MirrorUploader(store, path, keep=keep, registry=registry,
+                        policy=policy or _fast_policy())
+    lin.mirror_state = up.state_of_epoch
+    return path, store, lin, up
+
+
+def _commit_and_enqueue(path, lin, up, epoch):
+    lin.preserve_head()
+    sha = _write_ck(path, step=epoch, epoch=epoch)
+    lin.commit(epoch=epoch, step=epoch, sha256=sha)
+    up.enqueue(epoch=epoch, step=epoch, sha256=sha)
+    return sha
+
+
+# -- DirStore: blob semantics on a filesystem ------------------------------
+
+
+def test_dirstore_put_get_stat_roundtrip(tmp_path):
+    store = DirStore(str(tmp_path / "remote"))
+    src = tmp_path / "obj.bin"
+    src.write_bytes(b"x" * 4096)
+    sha = store.put(str(src), "obj.bin")
+    assert sha == sha256_of_file(str(src))
+    st = store.stat("obj.bin")
+    assert st == {"size": 4096, "sha256": sha}
+    dst = tmp_path / "back.bin"
+    assert store.get("obj.bin", str(dst)) == sha
+    assert dst.read_bytes() == b"x" * 4096
+    assert store.get_bytes("obj.bin") == b"x" * 4096
+    # list() shows objects only — never meta sidecars or tmp droppings.
+    assert store.list() == ["obj.bin"]
+    store.delete("obj.bin")
+    store.delete("obj.bin")  # idempotent: absent is not an error
+    assert store.stat("obj.bin") is None and store.list() == []
+
+
+def test_dirstore_meta_sidecar_makes_half_objects_invisible(tmp_path):
+    """The sidecar is written LAST on put and removed FIRST on delete, so
+    an object without its meta reads as ABSENT — the reader can never see
+    a verifiable-looking half-object."""
+    store = DirStore(str(tmp_path / "remote"))
+    os.makedirs(store.root, exist_ok=True)
+    # Bytes landed, meta never did (a put cut down mid-flight).
+    with open(os.path.join(store.root, "orphan.bin"), "wb") as f:
+        f.write(b"data")
+    assert store.stat("orphan.bin") is None
+    with pytest.raises(StoreError, match="no object 'orphan.bin'"):
+        store.get_bytes("orphan.bin")
+    # Meta without bytes (delete's crash window) is equally absent.
+    src = tmp_path / "o2"
+    src.write_bytes(b"d2")
+    store.put(str(src), "o2.bin")
+    os.unlink(os.path.join(store.root, "o2.bin"))
+    assert store.stat("o2.bin") is None
+
+
+def test_dirstore_torn_put_detected_on_read(tmp_path):
+    """inject_torn_next_put models the lie a torn network upload tells:
+    half the bytes land while the integrity record claims the full sha —
+    get/get_bytes must refuse the object, loudly."""
+    store = DirStore(str(tmp_path / "remote"))
+    src = tmp_path / "obj.bin"
+    src.write_bytes(os.urandom(2048))
+    store.inject_torn_next_put()
+    store.put(str(src), "obj.bin")
+    with pytest.raises(StoreError, match="sha-256 verification"):
+        store.get("obj.bin", str(tmp_path / "back.bin"))
+    assert not (tmp_path / "back.bin").exists()  # atomic: no torn local
+    with pytest.raises(StoreError, match="sha-256 verification"):
+        store.get_bytes("obj.bin")
+    # The very next put is clean — the fault is one-shot.
+    store.put(str(src), "obj.bin")
+    assert store.get_bytes("obj.bin") == src.read_bytes()
+
+
+def test_dirstore_slow_put_trips_the_per_op_deadline(tmp_path):
+    store = DirStore(str(tmp_path / "remote"))
+    src = tmp_path / "obj.bin"
+    src.write_bytes(b"slow")
+    store.inject_slow_put(5.0)
+    t0 = time.monotonic()
+    with pytest.raises(StoreTimeout, match="deadline"):
+        store.put(str(src), "obj.bin", deadline=time.monotonic() + 0.2)
+    assert time.monotonic() - t0 < 2.0  # timed out, did not sit out 5s
+    store.inject_slow_put(0.0)
+
+
+def test_dirstore_refuses_path_traversal_names(tmp_path):
+    store = DirStore(str(tmp_path / "remote"))
+    for bad in ("", "a/b", ".hidden"):
+        with pytest.raises(StoreError, match="invalid object name"):
+            store.stat(bad)
+
+
+def test_open_store_dispatch_and_cloud_paste_point(tmp_path):
+    assert isinstance(open_store(str(tmp_path / "d")), DirStore)
+    assert isinstance(open_store(f"dir://{tmp_path}/d"), DirStore)
+    assert isinstance(open_store(f"local://{tmp_path}/l"), LocalStore)
+    passthrough = DirStore(str(tmp_path / "p"))
+    assert open_store(passthrough) is passthrough
+    for scheme in ("gs://bkt/x", "s3://bkt/x", "az://bkt/x"):
+        with pytest.raises(StoreError, match="subclass CheckpointStore"):
+            open_store(scheme)
+
+
+# -- RetryPolicy: backoff bounds (satellite: retry/backoff unit tests) -----
+
+
+def test_retry_policy_doubles_to_cap_within_jitter_band():
+    pol = RetryPolicy(retries=6, base=0.5, cap=4.0, jitter=0.25)
+    rng = random.Random(11)
+    for k in range(6):
+        nominal = min(0.5 * 2 ** k, 4.0)
+        for _ in range(20):
+            d = pol.delay(k, rng)
+            assert nominal * 0.75 <= d <= nominal * 1.25
+    assert pol.delay(50, rng) <= 4.0 * 1.25  # the cap holds forever
+
+
+def test_retry_policy_validates_its_bounds():
+    with pytest.raises(ValueError, match="retries"):
+        RetryPolicy(retries=-1)
+    with pytest.raises(ValueError, match="backoff"):
+        RetryPolicy(base=-0.1)
+    with pytest.raises(ValueError, match="backoff"):
+        RetryPolicy(jitter=1.5)
+
+
+# -- MirrorUploader: the happy path ----------------------------------------
+
+
+def test_uploader_mirrors_commits_and_trims_remote(tmp_path):
+    path, store, lin, up = _mirrored_lineage(tmp_path, keep=2)
+    try:
+        for e in range(4):
+            _commit_and_enqueue(path, lin, up, e)
+        assert up.drain(30.0)
+        # Retention: newest `keep` epoch objects + the mirror manifest;
+        # epochs 0 and 1 were trimmed away.
+        assert store.list() == ["ck.npz.ep00000002", "ck.npz.ep00000003",
+                                "ck.npz" + MANIFEST_SUFFIX]
+        m = json.loads(store.get_bytes("ck.npz" + MANIFEST_SUFFIX))
+        assert m["mirror"] is True
+        assert m["head"]["epoch"] == 3 and m["head"]["step"] == 3
+        assert [e["epoch"] for e in m["retained"]] == [2]
+        assert up.lag_epochs() == 0
+        assert up.state_of_epoch(3) == "mirrored"
+        # No snapshot droppings left next to the live head.
+        local = os.listdir(os.path.dirname(path))
+        assert not [f for f in local if f.endswith(".mirror")]
+    finally:
+        up.close()
+
+
+def test_uploader_retries_through_a_flaky_remote(tmp_path):
+    reg = MetricsRegistry()
+    path, store, lin, up = _mirrored_lineage(tmp_path, registry=reg)
+    try:
+        store.inject_fail_puts(2)  # first two puts bounce, then recover
+        _commit_and_enqueue(path, lin, up, 0)
+        assert up.drain(30.0)
+        assert up.state_of_epoch(0) == "mirrored"
+        assert up.lag_epochs() == 0
+        fams = {f.name: f for f in reg.families()}
+        assert fams["ddp_ckpt_upload_retries_total"].value >= 2
+        assert fams["ddp_ckpt_upload_failures_total"].value == 0
+        assert fams["ddp_mirror_lag_epochs"].value == 0.0
+    finally:
+        up.close()
+
+
+def test_uploader_budget_exhaustion_degrades_to_lag_not_crash(tmp_path):
+    """A remote that stays down exhausts the retry budget: the epoch is
+    abandoned (failures counter up, lag >= 1) but NOTHING raises; a later
+    healthy epoch covers it and the lag returns to zero."""
+    reg = MetricsRegistry()
+    path, store, lin, up = _mirrored_lineage(
+        tmp_path, registry=reg, policy=_fast_policy(retries=1))
+    try:
+        store.inject_fail_puts(100)  # down for far longer than the budget
+        _commit_and_enqueue(path, lin, up, 0)
+        assert up.drain(30.0)
+        assert up.state_of_epoch(0) == "pending"  # still lagging, visible
+        assert up.lag_epochs() == 1
+        fams = {f.name: f for f in reg.families()}
+        assert fams["ddp_ckpt_upload_failures_total"].value >= 1
+        assert fams["ddp_mirror_lag_epochs"].value == 1.0
+        # Remote heals; the NEXT epoch mirrors and covers the lost one
+        # (the mirror head is now newer than anything that was pending).
+        store.inject_fail_puts(0)
+        _commit_and_enqueue(path, lin, up, 1)
+        assert up.drain(30.0)
+        assert up.lag_epochs() == 0
+        assert up.state_of_epoch(1) == "mirrored"
+    finally:
+        up.close()
+
+
+# -- uploader vs rotation races (satellite: race coverage) -----------------
+
+
+def test_rotation_outpacing_slow_uploads_never_wedges(tmp_path):
+    """keep=1 rotation deletes local generations while uploads of older
+    epochs are still in flight on a slow remote.  The enqueue-time hard
+    link snapshot means every upload still has bytes to read; stale
+    epochs resolve as mirrored or superseded, the newest epoch lands,
+    and no snapshot files leak."""
+    path, store, lin, up = _mirrored_lineage(tmp_path, keep=1)
+    try:
+        store.inject_slow_put(0.3)
+        for e in range(3):  # rotation trims ep0/ep1 while ep0 uploads
+            _commit_and_enqueue(path, lin, up, e)
+        store.inject_slow_put(0.0)
+        assert up.drain(60.0)
+        assert up.state_of_epoch(2) == "mirrored"
+        assert up.lag_epochs() == 0
+        m = json.loads(store.get_bytes("ck.npz" + MANIFEST_SUFFIX))
+        assert m["head"]["epoch"] == 2
+        local = os.listdir(os.path.dirname(path))
+        assert not [f for f in local if f.endswith(".mirror")]
+    finally:
+        up.close()
+
+
+def test_trim_never_deletes_inflight_or_retained_objects(tmp_path):
+    """The GC keep-set contract, unit-tested against the internals: an
+    in-flight upload's name and every retained mirror object survive a
+    trim; anything else goes."""
+    store = DirStore(str(tmp_path / "mirror"))
+    path = str(tmp_path / "ck.npz")
+    _write_ck(path, step=1, epoch=1)
+    up = MirrorUploader(store, path, keep=2, policy=_fast_policy())
+    try:
+        for name in ("ck.npz.ep00000001", "ck.npz.ep00000099",
+                     "ck.npz.ep00000050"):
+            store.put(path, name)
+        store.put_bytes("ck.npz" + MANIFEST_SUFFIX, b"{}")
+        with up._lock:
+            up._mirrored = [{"file": "ck.npz.ep00000001", "epoch": 1,
+                             "step": 1, "sha256": "x"}]
+            up._in_flight.add("ck.npz.ep00000099")
+        up._trim_remote()
+        # Retained + in-flight + manifest survive; the orphan is gone.
+        assert store.list() == ["ck.npz.ep00000001", "ck.npz.ep00000099",
+                                "ck.npz" + MANIFEST_SUFFIX]
+    finally:
+        up.close()
+
+
+def test_eight_thread_put_trim_interleave_stays_consistent(tmp_path):
+    """4 writer + 4 deleter threads hammering one DirStore: after the
+    dust settles every surviving object must still verify end-to-end —
+    concurrent delete can make an object vanish but can NEVER leave a
+    torn or unverifiable one behind (atomic visibility + sidecar order)."""
+    store = DirStore(str(tmp_path / "remote"))
+    src = tmp_path / "payload.bin"
+    src.write_bytes(os.urandom(8192))
+    errors = []
+
+    def writer(w):
+        try:
+            for i in range(12):
+                store.put(str(src), f"obj{w:02d}-{i:02d}")
+        except BaseException as e:  # noqa: BLE001 — surfaced at the join
+            errors.append(e)
+
+    def deleter(w):
+        try:
+            for i in range(12):
+                store.delete(f"obj{w:02d}-{i:02d}")
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = ([threading.Thread(target=writer, args=(w,))
+                for w in range(4)]
+               + [threading.Thread(target=deleter, args=(w,))
+                  for w in range(4)])
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    survivors = store.list()
+    assert not [n for n in survivors if n.endswith(".tmp")]
+    expected = sha256_of_file(str(src))
+    for name in survivors:
+        st = store.stat(name)
+        assert st is not None and st["sha256"] == expected
+        assert store.get_bytes(name) == src.read_bytes()  # verifies sha
+
+
+# -- tier-aware latest_verifiable ------------------------------------------
+
+
+def test_latest_verifiable_prefers_local_over_mirror(tmp_path):
+    path, store, lin, up = _mirrored_lineage(tmp_path)
+    for e in range(2):
+        _commit_and_enqueue(path, lin, up, e)
+    assert up.drain(30.0)
+    up.close()
+    ck, used = latest_verifiable(path, store=store)
+    assert ck.epoch == 1 and used == path  # the LOCAL head won
+
+
+def test_latest_verifiable_falls_back_to_mirror_after_total_wipe(tmp_path):
+    import shutil
+    path, store, lin, up = _mirrored_lineage(tmp_path)
+    for e in range(2):
+        _commit_and_enqueue(path, lin, up, e)
+    assert up.drain(30.0)
+    up.close()
+    shutil.rmtree(os.path.dirname(path))  # total local-disk loss
+    ck, used = latest_verifiable(path, store=store)
+    assert ck.epoch == 1 and int(ck.step) == 1
+    np.testing.assert_array_equal(np.asarray(ck.params["w"]),
+                                  np.full(4, 1.0, np.float32))
+    # The restored bytes landed back in the LOCAL tier, under the rotated
+    # name the candidate walk accepts on the next restart.
+    assert used == lineage_name(path, 1) and os.path.exists(used)
+
+
+def test_latest_verifiable_empty_mirror_is_not_an_error(tmp_path):
+    store = DirStore(str(tmp_path / "mirror"))
+    assert latest_verifiable(str(tmp_path / "ck.npz"), store=store) is None
+
+
+def test_latest_verifiable_damaged_mirror_names_the_tier(tmp_path):
+    """Local tier gone AND every mirror object torn: the failure must be
+    the named every-candidate-tried CheckpointError, with the mirror
+    candidates in the list — never a silent None or a bad restore."""
+    import shutil
+    path, store, lin, up = _mirrored_lineage(tmp_path, keep=1)
+    _commit_and_enqueue(path, lin, up, 0)
+    assert up.drain(30.0)
+    up.close()
+    shutil.rmtree(os.path.dirname(path))
+    # Rot every mirrored object body (meta keeps claiming the old sha).
+    for name in store.list():
+        if name.endswith(MANIFEST_SUFFIX):
+            continue
+        with open(os.path.join(store.root, name), "r+b") as f:
+            f.seek(0)
+            f.write(b"\xff" * 64)
+    with pytest.raises(CheckpointError) as ei:
+        latest_verifiable(path, store=store)
+    assert "ck.npz.ep00000000" in str(ei.value)
+
+
+def test_latest_verifiable_restores_sharded_v2_from_mirror(tmp_path):
+    """The sharded format mirrors as index + shard files; a mirror
+    restore must download the index under its rotated name and the
+    shards under their ORIGINAL names so the v2 reader's relative
+    references resolve."""
+    import shutil
+
+    import jax
+    from ddp_tpu.parallel import make_mesh
+    from ddp_tpu.train.ckpt_shard import save_checkpoint_sharded
+    mesh = make_mesh(4)
+    params = {"w": jax.device_put(np.arange(8, dtype=np.float32))}
+    path = str(tmp_path / "local" / "ck.npz")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    store = DirStore(str(tmp_path / "mirror"))
+    lin = CheckpointLineage(path, keep=2)
+    up = MirrorUploader(store, path, keep=2, policy=_fast_policy())
+    lin.mirror_state = up.state_of_epoch
+    lin.preserve_head()
+    sha, names = save_checkpoint_sharded(
+        path, params, {}, SGDState({"w": np.zeros(8, np.float32)}),
+        3, 1, mesh=mesh)
+    lin.commit(epoch=1, step=3, sha256=sha, shards=names)
+    up.enqueue(epoch=1, step=3, sha256=sha, shards=names)
+    assert up.drain(30.0)
+    up.close()
+    assert set(names) <= set(store.list())  # shard files mirrored too
+    shutil.rmtree(os.path.dirname(path))
+    ck, used = latest_verifiable(path, loader=load_checkpoint, store=store)
+    assert int(ck.step) == 3 and ck.epoch == 1
+    np.testing.assert_array_equal(np.asarray(ck.params["w"]),
+                                  np.arange(8, dtype=np.float32))
+    assert used == lineage_name(path, 1)
+
+
+# -- lineage manifests are tier-aware --------------------------------------
+
+
+def test_manifest_mirror_stamps_follow_upload_state(tmp_path):
+    """Each commit stamps entries with the mirror state KNOWN AT COMMIT
+    TIME: the fresh head is still pending (its upload was just queued),
+    while previously-mirrored generations read back as mirrored.  Old
+    manifests without the field stay readable (MIGRATING.md: local-only
+    is the default, never an error)."""
+    from ddp_tpu.resilience.lineage import read_manifest
+    path, store, lin, up = _mirrored_lineage(tmp_path)
+    _commit_and_enqueue(path, lin, up, 0)
+    assert up.drain(30.0)
+    _commit_and_enqueue(path, lin, up, 1)
+    assert up.drain(30.0)
+    # Re-commit epoch 2 AFTER epoch 1 mirrored: the retained epoch-1
+    # entry now carries its durable status.
+    _commit_and_enqueue(path, lin, up, 2)
+    assert up.drain(30.0)
+    up.close()
+    m = read_manifest(path)
+    assert m["head"]["mirror"] == "pending"  # stamped before its upload
+    by_epoch = {e["epoch"]: e for e in m["retained"]}
+    assert by_epoch[1]["mirror"] == "mirrored"
+    # A manifest with NO mirror fields (pre-tiering) still reads fine.
+    doc = json.load(open(path + MANIFEST_SUFFIX))
+    doc["head"].pop("mirror", None)
+    for e in doc["retained"]:
+        e.pop("mirror", None)
+    with open(path + MANIFEST_SUFFIX, "w") as f:
+        json.dump(doc, f)
+    m2 = read_manifest(path)
+    assert m2 is not None and "mirror" not in m2["head"]
+    ck, _ = latest_verifiable(path)
+    assert ck.epoch == 2

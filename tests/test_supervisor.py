@@ -303,6 +303,61 @@ def test_supervisor_with_real_stub_subprocess(tmp_path):
     assert sup.restarts_used == 1
 
 
+# -- satellite: exit 87 leaves a diagnosis.json repro artifact -------------
+
+
+def test_deterministic_exit_writes_diagnosis_artifact(tmp_path, capsys):
+    """The DETERMINISTIC verdict (exit 87) must leave ``diagnosis.json``
+    next to the ledger's metrics stream, pinning the failure signature,
+    the checkpoint the relaunches restored from (head ref incl.
+    data_state + mirror status), the mirror URI, and every death's last
+    guard/drift event — read back here field by field."""
+    mpath = str(tmp_path / "metrics.jsonl")
+    snap = str(tmp_path / "ck.npz")
+    head = {"file": "ck.npz", "epoch": 3, "step": 5, "sha256": "ab" * 32,
+            "data_state": {"epoch": 3, "offset": 1}, "mirror": "mirrored"}
+    with open(snap + ".manifest.json", "w") as f:
+        json.dump({"format": 1, "head": head, "retained": []}, f)
+    launcher = _FakeLauncher([1, 1], hook=_metrics_hook(mpath, [5, 5]))
+    sup, _ = _sup(launcher, tmp_path, max_restarts=10,
+                  child=["train.py", "--metrics_path", mpath,
+                         "--snapshot_path", snap,
+                         "--mirror", "dir:///nonexistent/mirror"])
+    assert sup.run() == SUPERVISOR_DETERMINISTIC_EXIT_STATUS
+    doc = json.load(open(tmp_path / "diagnosis.json"))
+    assert doc["schema"] == "supervisor_diagnosis/1"
+    assert doc["verdict"] == "deterministic"
+    assert doc["signature"] == {"what": "drift_detected", "step": 5,
+                                "occurrences": 2}
+    assert doc["exit_code"] == 1
+    assert doc["checkpoint"]["path"] == snap
+    assert doc["checkpoint"]["head"]["epoch"] == 3
+    assert doc["checkpoint"]["head"]["data_state"] == {"epoch": 3,
+                                                       "offset": 1}
+    assert doc["checkpoint"]["head"]["mirror"] == "mirrored"
+    assert doc["mirror"] == "dir:///nonexistent/mirror"
+    assert [e["event"] for e in doc["last_events"]] == \
+        ["drift_detected", "drift_detected"]
+    assert len(doc["deaths"]) == 2
+    assert doc["deaths"][1]["signature_count"] == 2
+    assert "--snapshot_path" in doc["child_argv"]
+    assert "diagnosis artifact written" in capsys.readouterr().err
+
+
+def test_diagnosis_without_snapshot_or_manifest_still_writes(tmp_path):
+    """Forensics must not depend on a healthy checkpoint tier: no
+    --snapshot_path flag at all still produces the artifact (checkpoint
+    null, mirror null)."""
+    mpath = str(tmp_path / "metrics.jsonl")
+    launcher = _FakeLauncher([1, 1], hook=_metrics_hook(mpath, [7, 7]))
+    sup, _ = _sup(launcher, tmp_path, max_restarts=10,
+                  child=["train.py", "--metrics_path", mpath])
+    assert sup.run() == SUPERVISOR_DETERMINISTIC_EXIT_STATUS
+    doc = json.load(open(tmp_path / "diagnosis.json"))
+    assert doc["checkpoint"] is None and doc["mirror"] is None
+    assert doc["signature"]["step"] == 7
+
+
 # -- satellite: unknown DDP_TPU_FAULT kinds fail loudly, both sides --------
 
 
@@ -319,6 +374,55 @@ def test_unknown_serve_fault_kind_raises_named_valueerror(monkeypatch):
             ValueError,
             match="unknown DDP_TPU_FAULT serve fault kind 'bogus'"):
         faults.install_serve_faults(object())
+
+
+# -- satellite: malformed NEW (mirror) fault forms fail loudly too ---------
+
+
+@pytest.mark.parametrize("spec,msg", [
+    ("fail_put@bogus=1", "unknown kwarg"),
+    ("fail_put@n=-1", "n must be"),
+    ("fail_put@n=0", "n must be"),
+    ("slow_put@seconds=5", "unknown kwarg"),
+    ("slow_put@ms=-200", "ms must be"),
+    ("torn_remote_object@x=1", "unknown kwarg"),
+    ("wipe_local_ckpt@step=3", "unknown kwarg"),
+    ("wipe_local_ckpt@epoch=-1", "epoch must be"),
+])
+def test_malformed_mirror_fault_forms_raise_valueerror(monkeypatch,
+                                                       spec, msg):
+    """A typo'd mirror-fault spec must die at INSTALL time with a named
+    ValueError — never be silently ignored into a drill that tests
+    nothing.  (A stand-in trainer with a DirStore-backed mirror is
+    enough: validation happens before any training runs.)"""
+    from ddp_tpu.resilience.store import DirStore
+
+    class _T:
+        snapshot_path = "/tmp/ck.npz"
+
+        def _run_epoch(self, *a, **kw):
+            return None
+    t = _T()
+    t._mirror_store = DirStore("/tmp/_fault_form_probe")
+    monkeypatch.setenv(faults.FAULT_ENV, spec)
+    with pytest.raises(ValueError, match=msg):
+        faults.install_env_faults(t)
+
+
+def test_mirror_faults_on_serve_side_raise_unknown_kind(monkeypatch):
+    """The mirror faults are TRAIN-side: the serve installer must refuse
+    them by name, same as any unknown kind."""
+    monkeypatch.setenv(faults.FAULT_ENV, "fail_put@n=1")
+    with pytest.raises(
+            ValueError,
+            match="unknown DDP_TPU_FAULT serve fault kind 'fail_put'"):
+        faults.install_serve_faults(object())
+
+
+def test_fail_put_without_mirror_names_the_requirement(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_ENV, "fail_put@n=2")
+    with pytest.raises(ValueError, match="--mirror"):
+        faults.install_env_faults(object())  # no _mirror_store at all
 
 
 # -- bench_trend ignores chaos scorecards ----------------------------------

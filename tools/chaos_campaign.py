@@ -22,6 +22,9 @@ claims to survive):
                    -> resume from the last clean snapshot
   torn_data_state  preempt, then tear the emergency checkpoint's resume
                    record on disk -> degraded epoch-boundary resume
+  local_wipe       preempt with ``--mirror`` on, then rm -rf the ENTIRE
+                   local checkpoint directory -> supervised resume must
+                   restore from the remote mirror tier alone
 
 Three control configs: A (64-sample synthetic, 2 steps/epoch — fast)
 for most drills; B (320-sample, 10 steps/epoch, save_every=2) for
@@ -90,6 +93,7 @@ _DRILLS = {
                      ["--guard_spike_factor", "4",
                       "--guard_action", "abort"]),
     "torn_data_state": ("A", None, []),  # two-stage, see _run_torn
+    "local_wipe": ("A", None, []),       # two-stage, see _run_local_wipe
 }
 
 
@@ -106,10 +110,11 @@ def _env(ndev: int) -> dict:
     return env
 
 
-def _child_argv(config: str, extra: List[str], workdir: str) -> List[str]:
+def _child_argv(config: str, extra: List[str], workdir: str,
+                snapshot: Optional[str] = None) -> List[str]:
     return ([os.path.join(_REPO, "multigpu.py")] + _CONFIGS[config][:2]
             + _CONFIGS[config][2:] + extra
-            + ["--snapshot_path", os.path.join(workdir, "ck.npz"),
+            + ["--snapshot_path", snapshot or os.path.join(workdir, "ck.npz"),
                "--metrics_path", os.path.join(workdir, "metrics.jsonl")])
 
 
@@ -132,11 +137,11 @@ def _supervised(child: List[str], env: dict, timeout: float, tag: str,
     return _run(argv, env, timeout, tag)
 
 
-def _final_ckpt(workdir: str):
+def _final_ckpt(snapshot: str):
     """The newest verifiable checkpoint of a finished run (the bytes the
     bit-parity verdict is about)."""
     from ddp_tpu.resilience.lineage import latest_verifiable
-    loaded = latest_verifiable(os.path.join(workdir, "ck.npz"))
+    loaded = latest_verifiable(snapshot)
     if loaded is None:
         return None
     return loaded[0]
@@ -225,6 +230,43 @@ def _run_torn(root: str, env: dict, timeout: float) -> dict:
             "wall_s": round(wall1 + wall2, 1)}
 
 
+def _run_local_wipe(root: str, env: dict, timeout: float) -> dict:
+    """Two-stage drill for TOTAL local-disk loss (drill six): (1) a SOLO
+    mirrored run preempted mid-epoch drains its remote copy before exit
+    75; (2) the entire local checkpoint DIRECTORY is removed — head,
+    rotated generations, manifest, everything; (3) the supervised
+    relaunch finds no local tier at all and must restore from the
+    ``DirStore`` mirror alone, then finish bit-identical to the control.
+    The checkpoint lives in its own subdirectory (not the workdir) so
+    the wipe is a true ``rm -rf`` of the durability tier without taking
+    the metrics/prom files the scorecard reads with it."""
+    workdir = os.path.join(root, "local_wipe")
+    ckdir = os.path.join(workdir, "ckpt")
+    os.makedirs(ckdir, exist_ok=True)
+    snapshot = os.path.join(ckdir, "ck.npz")
+    mirror = os.path.join(workdir, "mirror")
+    child = _child_argv("A", ["--mirror", mirror], workdir,
+                        snapshot=snapshot)
+    stage_env = dict(env)
+    stage_env["DDP_TPU_FAULT"] = "sigterm@step=4"
+    rc, wall1 = _run([sys.executable] + child, stage_env, timeout,
+                     "local_wipe stage 1 (preempt, mirror draining)")
+    if rc != 75:
+        return {"workdir": workdir, "supervisor_exit": rc,
+                "snapshot": snapshot,
+                "fault": "sigterm@step=4 + rm -rf local ckpt dir",
+                "error": f"stage-1 preemption exited {rc}, wanted 75"}
+    shutil.rmtree(ckdir)  # total local-disk loss: no tier-1 bytes remain
+    print(f"[chaos] local_wipe: removed {ckdir} (local tier gone; "
+          f"mirror at {mirror} is the only copy)", flush=True)
+    rc, wall2 = _supervised(child + ["--resume"], env, timeout,
+                            "local_wipe stage 2 (resume from mirror)")
+    return {"workdir": workdir, "supervisor_exit": rc,
+            "snapshot": snapshot,
+            "fault": "sigterm@step=4 + rm -rf local ckpt dir",
+            "wall_s": round(wall1 + wall2, 1)}
+
+
 def run_campaign(drills: List[str], root: str, env: dict,
                  timeout: float) -> dict:
     configs = sorted({_DRILLS[d][0] for d in drills})
@@ -234,6 +276,8 @@ def run_campaign(drills: List[str], root: str, env: dict,
         config, fault, extra = _DRILLS[name]
         if name == "torn_data_state":
             res = _run_torn(root, env, timeout)
+        elif name == "local_wipe":
+            res = _run_local_wipe(root, env, timeout)
         else:
             workdir = os.path.join(root, name)
             os.makedirs(workdir, exist_ok=True)
@@ -241,11 +285,16 @@ def run_campaign(drills: List[str], root: str, env: dict,
             rc, wall = _supervised(child, env, timeout, name, fault=fault)
             res = {"workdir": workdir, "supervisor_exit": rc,
                    "wall_s": round(wall, 1)}
-        res["fault"] = fault or "sigterm@epoch=1 + torn data_state record"
+        res.setdefault(
+            "fault", fault or "sigterm@epoch=1 + torn data_state record")
         res["control"] = config
         res.update(_supervisor_stats(res["workdir"]))
-        bit = _params_equal(_final_ckpt(res["workdir"]),
-                            _final_ckpt(controls[config]["workdir"]))
+        snap = res.pop("snapshot", None) or os.path.join(
+            res["workdir"], "ck.npz")
+        bit = _params_equal(
+            _final_ckpt(snap),
+            _final_ckpt(os.path.join(controls[config]["workdir"],
+                                     "ck.npz")))
         res["bit_identical"] = bit
         res["zero_data_loss"] = bit and res["supervisor_exit"] == 0
         res["pass"] = res["zero_data_loss"]
