@@ -27,6 +27,7 @@ build), invariants derive from (kind, zero, plan) in
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Callable, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -64,6 +65,7 @@ class _Ctx(NamedTuple):
     plan: Optional[Any]
     params: Any
     stats: Any
+    model_name: str = DEFAULT_MODEL
 
 
 def _sds(tree):
@@ -149,8 +151,73 @@ def _build_drift(ctx: _Ctx, name: str) -> BuiltProgram:
     return BuiltProgram(name, "audit", False, fn, (_sds(ctx.params),), None)
 
 
-def _spec(name, kind, *, zero=False, tp=False, accum=False) -> ProgramSpec:
-    if kind == "update":
+def auto_plan_path(model_name: str, mesh_2d: Tuple[int, int]) -> str:
+    """Repo-root path of the COMMITTED searched plan for a (model, mesh)
+    pair — ``plans/<model>_<d>x<m>.autoplan.json``, written by
+    ``python -m ddp_tpu.parallel.tp --search --out``.  The golden plan
+    CI audits and trains against lives at this path for the default
+    (deepnn, (2,4)) context."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    d, m = int(mesh_2d[0]), int(mesh_2d[1])
+    return os.path.join(root, "plans",
+                        f"{model_name}_{d}x{m}.autoplan.json")
+
+
+def _ctx_mesh_2d(ctx: _Ctx) -> Tuple[int, int]:
+    from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+    shape = dict(ctx.mesh2d.shape)
+    return int(shape[DATA_AXIS]), int(shape[MODEL_AXIS])
+
+
+def _auto_doc(ctx: _Ctx) -> Optional[dict]:
+    """The committed searched plan doc for this context's (model, mesh),
+    or None when no plan is committed.  A file that EXISTS but fails
+    validation or names a different model/mesh raises — a corrupt
+    committed plan must fail the audit, not silently vanish from it."""
+    path = auto_plan_path(ctx.model_name, _ctx_mesh_2d(ctx))
+    if not os.path.exists(path):
+        return None
+    from ..parallel.tp.autoplan import read_plan_doc
+    doc = read_plan_doc(path)
+    if doc["model"] != ctx.model_name or \
+            tuple(doc["mesh_shape"]) != _ctx_mesh_2d(ctx):
+        raise ValueError(
+            f"{path} names model {doc['model']!r} mesh "
+            f"{doc['mesh_shape']} but its filename claims "
+            f"({ctx.model_name!r}, {_ctx_mesh_2d(ctx)})")
+    return doc
+
+
+def _build_auto(ctx: _Ctx, name: str) -> BuiltProgram:
+    """The train step under the committed searched plan — the auto-plan
+    twin of ``train_step@tp``, built through the same head builders so
+    the strict auditor checks the exact program ``--auto_plan`` runs.
+    The doc drives the recipe AND the ZeRO choice (the BuiltProgram's
+    ``zero`` comes from the doc, not the registry row)."""
+    doc = _auto_doc(ctx)
+    assert doc is not None  # build_programs skips the entry otherwise
+    from ..parallel.tp.autoplan import plan_from_doc
+    plan = plan_from_doc(doc, ctx.params, ctx.stats)
+    zero = bool(doc.get("zero"))
+    cfg, sched = _sgd()
+    if zero:
+        from ..train.zero import make_train_step_zero
+        fn = make_train_step_zero(ctx.model, cfg, sched, ctx.mesh2d,
+                                  plan=plan)
+    else:
+        from ..train.step import make_train_step
+        fn = make_train_step(ctx.model, cfg, sched, ctx.mesh2d, plan=plan)
+    state = _train_state(ctx, ctx.mesh2d, zero=zero, plan=plan)
+    return BuiltProgram(name, "update", zero, fn,
+                        (state, _batch(), _rng()), plan)
+
+
+def _spec(name, kind, *, zero=False, tp=False, accum=False,
+          auto=False) -> ProgramSpec:
+    if auto:
+        build = _build_auto
+    elif kind == "update":
         build = functools.partial(_build_step, accum=accum, zero=zero,
                                   tp=tp)
     elif kind == "eval":
@@ -172,6 +239,10 @@ REGISTRY: Tuple[ProgramSpec, ...] = (
     _spec("train_step@tp", "update", tp=True),
     _spec("train_step_accum@tp", "update", tp=True, accum=True),
     _spec("train_step_zero@tp", "update", zero=True, tp=True),
+    # The searched plan (plans/<model>_<d>x<m>.autoplan.json) as a
+    # first-class audited program: present only when a plan is committed
+    # for the context's (model, mesh).
+    _spec("train_step@auto", "update", auto=True),
     _spec("eval_step@dp8", "eval"),
     _spec("eval_step@tp", "eval", tp=True),
     _spec("serve_forward@dp8", "forward"),
@@ -203,7 +274,7 @@ def build_context(model_name: str = DEFAULT_MODEL,
             plan = plan_for_model(model_name, params, stats, model_size=m)
         except ValueError:
             plan = None  # model without a recipe: tp entries are skipped
-    return _Ctx(model, mesh1d, mesh2d, plan, params, stats)
+    return _Ctx(model, mesh1d, mesh2d, plan, params, stats, model_name)
 
 
 def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
@@ -220,6 +291,8 @@ def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
         if wanted is not None and spec.name not in wanted:
             continue
         if spec.tp and ctx.plan is None:
+            continue
+        if spec.name.endswith("@auto") and _auto_doc(ctx) is None:
             continue
         out.append(spec.build(ctx, spec.name))
     return out

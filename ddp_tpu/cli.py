@@ -95,6 +95,17 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "checkpoints stay canonical, so snapshots "
                         "interchange with any other mesh shape (incl. "
                         "1-D serving).  Default: 1-D data-parallel mesh")
+    p.add_argument("--auto_plan", default=None, metavar="PLAN.json",
+                   help="Train under a searched sharding plan "
+                        "(python -m ddp_tpu.parallel.tp --search --out "
+                        "PLAN.json): the plan doc carries the mesh shape, "
+                        "per-layer layout recipe and ZeRO choice, so this "
+                        "one flag replaces --mesh_shape [+ --shard_update] "
+                        "for the searched configuration.  --mesh_shape/"
+                        "--num_devices may still be passed but must agree "
+                        "with the doc; --shard_update still force-enables "
+                        "ZeRO on top of a zero=off plan.  TP_RECIPE "
+                        "remains the no-flag default (MIGRATING.md)")
     p.add_argument("--spawn", default=0, type=int, metavar="N",
                    help="Fork N local processes wired by a fresh rendezvous "
                         "and run this exact command in each (the reference's "
@@ -370,7 +381,11 @@ def _preflight_audit(args: argparse.Namespace) -> None:
     collective) aborts the run here instead of wasting a chip
     reservation."""
     from .analysis.__main__ import run as audit_run
-    if args.mesh_shape:
+    if getattr(args, "auto_plan", None):
+        from .parallel.tp.autoplan import read_plan_doc
+        d, m = read_plan_doc(args.auto_plan)["mesh_shape"]
+        shape = f"{d},{m}"
+    elif args.mesh_shape:
         shape = str(args.mesh_shape)
     else:
         import jax  # backend decides the 1-D width, same as run() will
@@ -543,7 +558,39 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     """The reference ``main()`` body proper (multigpu.py:224-248), between
     rendezvous and teardown — both owned by :func:`run`."""
     _enable_compilation_cache()
-    if args.mesh_shape:
+    # A searched plan doc (--auto_plan) IS the mesh/zero configuration:
+    # the search already chose the shape and the ZeRO setting, so the doc
+    # drives both and any redundant flags must agree rather than win.
+    auto_doc = None
+    if getattr(args, "auto_plan", None):
+        from .parallel.tp.autoplan import read_plan_doc
+        try:
+            auto_doc = read_plan_doc(args.auto_plan)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"--auto_plan: {e}")
+        if auto_doc["model"] != args.model:
+            raise SystemExit(
+                f"--auto_plan was searched for model "
+                f"{auto_doc['model']!r} but this run trains "
+                f"{args.model!r}; re-run the search for this model")
+        if auto_doc.get("zero") and not args.shard_update:
+            args.shard_update = True
+            if jax.process_index() == 0:
+                print("auto plan: ZeRO update sharding on "
+                      "(plan doc zero=true)")
+    if auto_doc is not None:
+        d, m = (int(v) for v in auto_doc["mesh_shape"])
+        if args.mesh_shape and args.mesh_shape.replace("x", ",") != f"{d},{m}":
+            raise SystemExit(
+                f"--mesh_shape {args.mesh_shape} contradicts the auto "
+                f"plan's searched mesh {d},{m}; drop one")
+        if args.num_devices and args.num_devices != d * m:
+            raise SystemExit(
+                f"--num_devices {args.num_devices} contradicts the auto "
+                f"plan's searched mesh {d},{m} (= {d * m} devices); "
+                "drop one")
+        mesh = make_mesh(shape=(d, m))
+    elif args.mesh_shape:
         try:
             d, m = (int(x) for x in args.mesh_shape.split(","))
         except ValueError:
@@ -592,7 +639,18 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     # table describe exactly what will train; built for any --mesh_shape
     # mesh (m=1 included — the tp code path then runs trivially).
     tp_plan = None
-    if args.mesh_shape:
+    if auto_doc is not None:
+        from .parallel.tp.autoplan import plan_from_doc
+        from .parallel.tp.plan import format_plan_table
+        tp_plan = plan_from_doc(auto_doc, params, batch_stats)
+        if jax.process_index() == 0:
+            if tp_plan is not None:
+                print(format_plan_table(tp_plan))
+            else:
+                print(f"auto plan: pure data parallelism over "
+                      f"{mesh.devices.size} devices (searched layout "
+                      "kept every layer replicated)")
+    elif args.mesh_shape:
         from .parallel.mesh import model_axis_size
         from .parallel.tp.plan import format_plan_table, plan_for_model
         tp_plan = plan_for_model(args.model, params, batch_stats,

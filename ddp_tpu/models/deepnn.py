@@ -41,6 +41,13 @@ TP_RECIPE = {
     "classifier/linear1": "row",
 }
 
+# Activation-width barriers for the auto-plan search
+# (parallel/tp/autoplan.py): the activation LEAVING each named layer must
+# be full-width.  conv3 feeds the NHWC flatten ([N,8,8,32] -> [N,2048]); a
+# channel-sharded input would flatten to an interleaved subset of the 2048
+# vector that no contiguous row-parallel weight shard matches.
+TP_BARRIERS = ("features/conv3",)
+
 # The layer consuming the NETWORK INPUT.  Declared (not inferred) because
 # the plan's expected-collectives accounting needs it: a train step takes
 # gradients w.r.t. params only, so the stem's column-style input-gradient
@@ -83,19 +90,32 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
           rng: Optional[jax.Array] = None,
           compute_dtype: Optional[jnp.dtype] = None,
           tp_axis: Optional[str] = None,
+          tp_recipe: Optional[Dict[str, str]] = None,
           ) -> Tuple[jax.Array, Dict]:
     """Forward pass.  With ``tp_axis`` set (inside a shard_map over that
-    mesh axis, params sharded per TP_RECIPE), the row-parallel members run
+    mesh axis, params sharded per the recipe), the row-parallel members run
     through the tp wrappers — partial sums psum'd over ``tp_axis``, bias
     after the reduction — and dropout draws the full-width mask so its
     bits match the unsharded run (parallel/tp/layers.py).  Column-parallel
-    members are locally byte-identical to the unsharded ops, so they need
-    no branching at all."""
+    members are locally byte-identical to the unsharded ops, so they only
+    branch for the backward's ``column_input`` psum.
+
+    ``tp_recipe`` overrides the module's TP_RECIPE with an explicit
+    per-layer style mapping (the auto-plan path,
+    parallel/tp/autoplan.py); layers it omits — or maps to
+    ``"replicated"`` — run the plain unsharded ops even under ``tp_axis``
+    (their params are replicated over ``model``, and every model shard on
+    one data row computes the same activations from the same rng)."""
     del batch_stats
+    recipe = TP_RECIPE if tp_recipe is None else tp_recipe
     if tp_axis is not None:
         from ..parallel.tp.layers import (column_conv2d, column_linear,
                                           row_conv2d, row_linear,
                                           sharded_dropout)
+    def style(path):
+        if tp_axis is None:
+            return None
+        return recipe.get(path, "replicated")
     cd = compute_dtype or x.dtype
     x = x.astype(cd)
     idx = 0
@@ -105,35 +125,44 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
             continue
         conv = params["features"][f"conv{idx}"]
         k, b = conv["kernel"].astype(cd), conv["bias"].astype(cd)
-        if tp_axis is None:
-            x = conv2d(x, k, b, stride=1, padding=1)
-        elif TP_RECIPE[f"features/conv{idx}"] == "row":
+        s = style(f"features/conv{idx}")
+        if s == "row":
             x = row_conv2d(x, k, b, tp_axis, stride=1, padding=1)
-        else:
+        elif s == "column":
             x = column_conv2d(x, k, b, tp_axis, stride=1, padding=1)
+        else:
+            x = conv2d(x, k, b, stride=1, padding=1)
         x = jax.nn.relu(x)
         idx += 1
     x = x.reshape(x.shape[0], -1)  # [N,8,8,32] -> [N,2048] (NHWC order)
     cls = params["classifier"]
     w0, b0 = (cls["linear0"]["weight"].astype(cd),
               cls["linear0"]["bias"].astype(cd))
-    if tp_axis is not None:
+    s0 = style("classifier/linear0")
+    if s0 == "column":
         x = column_linear(x, w0, b0, tp_axis)
+    elif s0 == "row":
+        x = row_linear(x, w0, b0, tp_axis)
     else:
         x = linear(x, w0, b0)
     x = jax.nn.relu(x)
     if train:
         if rng is None:
             raise ValueError("DeepNN needs an rng for dropout in train mode")
-        if tp_axis is not None:
+        # The mask is always drawn at FULL width; the sharded form only
+        # exists to slice it when the activation is linear0's column shard.
+        if s0 == "column":
             x = sharded_dropout(rng, x, DROPOUT_RATE, train=True,
                                 axis_name=tp_axis)
         else:
             x = dropout(rng, x, DROPOUT_RATE, train=True)
     w1, b1 = (cls["linear1"]["weight"].astype(cd),
               cls["linear1"]["bias"].astype(cd))
-    if tp_axis is not None:
+    s1 = style("classifier/linear1")
+    if s1 == "row":
         logits = row_linear(x, w1, b1, tp_axis)
+    elif s1 == "column":
+        logits = column_linear(x, w1, b1, tp_axis)
     else:
         logits = linear(x, w1, b1)
     return logits.astype(jnp.float32), {}

@@ -65,6 +65,25 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
+def abstract_mesh(shape: Tuple[int, int]):
+    """A deviceless 2-D ``(data, model)`` AbstractMesh — the auto-plan
+    search's substrate (parallel/tp/autoplan.py): ``jax.make_jaxpr`` traces
+    the REAL step builders against it for ANY mesh shape, so a laptop/CI
+    CPU box can price v4-128 layouts without owning a single chip.  Only
+    tracing works on it — no ``device_put``, no execution."""
+    d, m = int(shape[0]), int(shape[1])
+    if d < 1 or m < 1:
+        raise ValueError(f"mesh shape must be positive, got {shape}")
+    return jax.sharding.AbstractMesh(((DATA_AXIS, d), (MODEL_AXIS, m)))
+
+
+def mesh_size(mesh) -> int:
+    """Total device count of a mesh, via its axis extents — unlike
+    ``mesh.devices.size`` this also works on a deviceless
+    :func:`abstract_mesh`."""
+    return int(np.prod([int(v) for v in dict(mesh.shape).values()]))
+
+
 def data_axis_size(mesh: Mesh) -> int:
     """Number of batch shards — the ``data`` axis extent.  THE divisor for
     every piece of batch math: on a 2-D mesh the batch is split over

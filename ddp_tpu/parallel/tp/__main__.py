@@ -1,20 +1,86 @@
-"""``python -m ddp_tpu.parallel.tp`` — print a model's sharding plan table.
+"""``python -m ddp_tpu.parallel.tp`` — plan tables and the auto-plan search.
 
-The offline view of what the CLI prints at startup under ``--mesh_shape``:
-resolve the model's TP_RECIPE against a fresh param pytree at the given
-model-axis size, validate it, print the plan table with the per-layer
-predicted-cost column (``analysis.costmodel.layer_forward_costs``; the
-column is omitted when the recipe doesn't map 1:1 onto the traced
-conv/dot ops), and exit non-zero on an infeasible plan.  CI
-schema-checks this output, footers included.
+Default mode is the offline view of what the CLI prints at startup under
+``--mesh_shape``: resolve the model's TP_RECIPE against a fresh param
+pytree at the given model-axis size, validate it, print the plan table
+with the per-layer predicted-cost column
+(``analysis.costmodel.layer_forward_costs``; the column is omitted when
+the recipe doesn't map 1:1 onto the traced conv/dot ops), and exit
+non-zero on an infeasible plan.  CI schema-checks this output, footers
+included.
+
+``--search`` runs the auto-sharding search instead (tp/autoplan.py):
+enumerate layouts x mesh shapes x ZeRO over ``--devices`` (ANY device
+budget — candidates are priced on a deviceless abstract mesh, so a
+laptop can search v4-128 shapes), print the ranked candidate table and
+the chosen plan's table, and write the plan-as-data JSON with ``--out``
+— the file ``ddp_tpu.cli --auto_plan`` loads.  ``--calib`` points at a
+``bench.py --calibrate_cost`` record (or any prior auto-plan JSON) for
+the measured per-op-class coefficients the pricing needs.
 """
 from __future__ import annotations
 
 import argparse
+import json
+import sys
 
 import jax
 
 from .plan import format_plan_table, plan_for_model
+
+
+def _parse_shape(arg: str):
+    parts = [int(v) for v in arg.replace("x", ",").split(",") if v]
+    if len(parts) != 2 or min(parts) < 1:
+        raise SystemExit(f"--mesh_shape wants D,M (got {arg!r})")
+    return tuple(parts)
+
+
+def _search(args) -> int:
+    from ...analysis.search import coefficients_from
+    from .autoplan import (format_search_table, plan_doc_dumps,
+                           plan_from_doc, search_plan)
+    try:
+        with open(args.calib, "r", encoding="utf-8") as fh:
+            coeffs = coefficients_from(json.load(fh))
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        print(f"--calib: {e}", file=sys.stderr)
+        return 2
+    zero_options = {"both": (False, True), "on": (True,),
+                    "off": (False,)}[args.zero]
+    try:
+        result = search_plan(
+            args.model, coefficients=coeffs,
+            total_devices=args.devices,
+            mesh_shapes=([_parse_shape(args.mesh_shape)]
+                         if args.mesh_shape else None),
+            hbm_budget_bytes=(int(args.hbm_budget_gb * 2**30)
+                              if args.hbm_budget_gb else None),
+            global_batch=args.global_batch,
+            zero_options=zero_options,
+            log=print if args.verbose else None)
+    except ValueError as e:
+        print(f"search failed: {e}", file=sys.stderr)
+        return 1
+    print(format_search_table(result, args.model))
+    doc = result.doc
+    from ...models import get_model
+    model = get_model(args.model)
+    params, batch_stats = model.init(jax.random.key(0))
+    plan = plan_from_doc(doc, params, batch_stats)
+    if plan is not None:
+        from ...analysis.costmodel import layer_forward_costs
+        costs = layer_forward_costs(model, plan, params, batch_stats)
+        print(format_plan_table(plan, layer_costs=costs))
+    else:
+        print(f"chosen plan is pure data parallelism over "
+              f"{doc['mesh_shape'][0]} devices — no tensor-parallel "
+              f"plan table")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            fh.write(plan_doc_dumps(doc))
+        print(f"wrote auto plan to {args.out}")
+    return 0
 
 
 def main() -> None:
@@ -24,8 +90,43 @@ def main() -> None:
     p.add_argument("--model", default="deepnn",
                    choices=["vgg", "deepnn", "resnet18"])
     p.add_argument("--model_axis", default=4, type=int, metavar="M",
-                   help="model-axis size to plan for (default 4)")
+                   help="model-axis size to plan for (default 4; "
+                        "plan-table mode only)")
+    p.add_argument("--search", action="store_true",
+                   help="run the auto-sharding search instead of "
+                        "printing the hand recipe's table")
+    p.add_argument("--devices", default=8, type=int, metavar="N",
+                   help="total device budget to search over (default 8; "
+                        "any size — pricing is static, no devices "
+                        "needed)")
+    p.add_argument("--mesh_shape", default=None, metavar="D,M",
+                   help="constrain the search to one mesh shape "
+                        "(default: every factorization of --devices)")
+    p.add_argument("--calib", default=None, metavar="CALIB.json",
+                   help="calibrated coefficients source: a bench.py "
+                        "--calibrate_cost record or a prior auto-plan "
+                        "JSON (required with --search)")
+    p.add_argument("--hbm_budget_gb", default=None, type=float,
+                   metavar="GB",
+                   help="prune candidates whose per-shard liveness peak "
+                        "exceeds this budget (default: no memory prune)")
+    p.add_argument("--global_batch", default=32, type=int,
+                   help="global rows per step the candidates are priced "
+                        "at (default 32)")
+    p.add_argument("--zero", default="both", choices=["both", "on", "off"],
+                   help="ZeRO dimension of the search space "
+                        "(default both)")
+    p.add_argument("--out", default=None, metavar="PLAN.json",
+                   help="write the chosen plan-as-data JSON here "
+                        "(the file cli --auto_plan loads)")
+    p.add_argument("--verbose", action="store_true",
+                   help="log every candidate as it is priced")
     args = p.parse_args()
+    if args.search:
+        if not args.calib:
+            p.error("--search needs --calib (a bench.py --calibrate_cost "
+                    "record or a prior auto-plan JSON)")
+        raise SystemExit(_search(args))
     from ...analysis.costmodel import layer_forward_costs
     from ...models import get_model
     model = get_model(args.model)

@@ -1,0 +1,387 @@
+"""Auto-sharding search: the cost-model-driven layout planner.
+
+Retires the hand-written ``TP_RECIPE`` as the *only* way to shard a
+model: for a given model and device budget this module enumerates
+candidate per-layer layouts (replicated / column-parallel /
+row-parallel) x candidate mesh shapes ``(d, m)`` x ZeRO on/off, prices
+every candidate STATICALLY (analysis/search.py: the real step builders
+traced on a deviceless abstract mesh, costed through the calibrated
+coefficients, peak-HBM from the liveness walk), prunes the infeasible
+ones, and emits the cheapest survivor as a ``TP_RECIPE``-compatible
+plan-as-data JSON doc — loadable via ``--auto_plan`` on the CLI and
+printable via ``python -m ddp_tpu.parallel.tp --search``.  The search
+is exactly the automatic-layout framing of Mesh-TensorFlow (arXiv
+1811.02084) over the weight-update sharding space of arXiv 2004.13336
+(PAPERS.md), grounded in this repo's measured coefficients.
+
+**The layout space is a DFA over activation width.**  Walking the
+model's recipe layers in network order, the activation entering each
+layer is either ``full`` (every model shard holds all features) or
+``sharded`` (each shard holds its column slice):
+
+- ``column`` consumes full, produces sharded (output dim split);
+- ``row`` consumes sharded, produces full (partial sums psum'd);
+- ``replicated`` consumes full, produces full (plain op);
+- the terminal state must be full (the loss consumes full logits), and
+  every model-declared ``TP_BARRIERS`` layer must produce full — e.g.
+  deepnn's conv3, whose NHWC flatten would interleave a channel-sharded
+  activation into a slice no contiguous row shard matches.
+
+Everything the hand path enforces, the auto path enforces identically:
+candidate plans resolve through ``plan_for_model``'s divisibility/drift
+rules (tp/plan.py), and every candidate's traced program must satisfy
+its own plan's ``expected_collectives`` arithmetic under the strict
+jaxpr auditor before it may win — a plan the auditor rejects is pruned,
+never emitted.
+
+**Pruning reasons** (reported per candidate, and counted in the doc):
+
+- ``batch``       — global batch not divisible by the data axis;
+- ``divisibility``— a sharded dim not divisible by the model axis;
+- ``audit``       — traced collectives violate the plan's invariants;
+- ``hbm``         — liveness peak exceeds the ``--hbm_budget`` bytes.
+
+The emitted doc is deterministic — same model, device budget and
+coefficients produce bit-identical JSON (no timestamps, sorted keys) —
+so golden plans can be committed and CI can diff them.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+PLAN_FORMAT_VERSION = 1
+PLAN_KIND = "ddp_tpu.autoplan"
+
+_STYLE_LETTER = {"column": "c", "row": "r", "replicated": "-"}
+
+# Model registry name -> module name where it differs (tp/plan.py's map).
+_MODULE_FOR = {"resnet18": "resnet"}
+
+
+class SearchSpace(NamedTuple):
+    """What the model declares about its shardable structure."""
+    layers: Tuple[str, ...]    # recipe layers, network order
+    barriers: Tuple[str, ...]  # layers whose OUTPUT must be full-width
+    stem: Optional[str]        # the layer consuming the network input
+
+
+def search_space_for(model_name: str) -> SearchSpace:
+    """The search space a model module declares: its ``TP_RECIPE`` keys
+    (network order — the order the hand recipe already relies on for the
+    column/row pairing), ``TP_BARRIERS``, ``TP_STEM``.  A model with no
+    recipe has an EMPTY layer space: the search still runs, over mesh
+    shapes and ZeRO only (pure data parallelism)."""
+    mod = importlib.import_module(
+        f"ddp_tpu.models.{_MODULE_FOR.get(model_name, model_name)}")
+    recipe = getattr(mod, "TP_RECIPE", None) or {}
+    return SearchSpace(layers=tuple(recipe),
+                       barriers=tuple(getattr(mod, "TP_BARRIERS", ())),
+                       stem=getattr(mod, "TP_STEM", None))
+
+
+def enumerate_recipes(space: SearchSpace) -> List[Dict[str, str]]:
+    """Every per-layer style assignment the activation-width DFA admits
+    (module docstring).  Deterministic order: depth-first with styles
+    tried replicated -> column -> row at each layer."""
+    layers = space.layers
+    barriers = set(space.barriers)
+
+    def walk(i: int, sharded: bool) -> List[List[str]]:
+        if i == len(layers):
+            return [[]] if not sharded else []
+        out: List[List[str]] = []
+        for style in ("replicated", "column", "row"):
+            if style == "row":
+                if not sharded:
+                    continue          # row consumes a sharded activation
+            elif sharded:
+                continue              # replicated/column consume full
+            next_sharded = style == "column"
+            if next_sharded and layers[i] in barriers:
+                continue              # barrier output must be full-width
+            for rest in walk(i + 1, next_sharded):
+                out.append([style] + rest)
+        return out
+
+    return [dict(zip(layers, styles)) for styles in walk(0, False)]
+
+
+def candidate_mesh_shapes(total_devices: int) -> List[Tuple[int, int]]:
+    """Every ``(d, m)`` factorization of the device budget, m ascending
+    — ``(N, 1)`` (pure DP) through ``(1, N)`` (pure TP)."""
+    if total_devices < 1:
+        raise ValueError(f"total_devices must be >= 1, got {total_devices}")
+    return [(total_devices // m, m) for m in range(1, total_devices + 1)
+            if total_devices % m == 0]
+
+
+def _is_sharded(recipe: Dict[str, str]) -> bool:
+    return any(s in ("column", "row") for s in recipe.values())
+
+
+def _candidate_key(mesh_shape, recipe, zero) -> str:
+    return json.dumps({"mesh_shape": list(mesh_shape), "recipe": recipe,
+                       "zero": bool(zero)}, sort_keys=True)
+
+
+class SearchResult(NamedTuple):
+    doc: dict               # the chosen plan-as-data JSON doc
+    candidates: List[dict]  # every candidate row, ranked, pruned last
+    pruned: Dict[str, int]  # prune-reason -> count
+
+
+def search_plan(model_name: str, *, coefficients: Dict[str, float],
+                total_devices: Optional[int] = None,
+                mesh_shapes: Optional[List[Tuple[int, int]]] = None,
+                hbm_budget_bytes: Optional[int] = None,
+                global_batch: int = 32,
+                zero_options: Tuple[bool, ...] = (False, True),
+                log=None) -> SearchResult:
+    """Run the full search.  Pass ``mesh_shapes`` to constrain the mesh
+    (the CI golden search pins ``[(2, 4)]``), else every factorization
+    of ``total_devices`` is explored.  ``coefficients`` are the four
+    calibrated per-op-class rates (``bench.py --calibrate_cost``, or any
+    doc ``analysis.search.coefficients_from`` accepts).
+
+    Ranking: lowest predicted per-shard ms, ties broken by lower peak
+    HBM, then by the candidate's canonical JSON key — fully
+    deterministic.  Raises ``ValueError`` when every candidate was
+    pruned (e.g. an HBM budget nothing fits under)."""
+    from ...analysis.search import (audit_candidate, coefficients_from,
+                                    price_closed, trace_candidate)
+    coefficients = coefficients_from(coefficients)
+    if mesh_shapes is None:
+        if total_devices is None:
+            raise ValueError("pass total_devices or mesh_shapes")
+        mesh_shapes = candidate_mesh_shapes(total_devices)
+    else:
+        mesh_shapes = [(int(d), int(m)) for d, m in mesh_shapes]
+        total_devices = total_devices or max(d * m for d, m in mesh_shapes)
+    space = search_space_for(model_name)
+    recipes = enumerate_recipes(space)
+
+    candidates: List[dict] = []
+    pruned: Dict[str, int] = {}
+
+    def note(reason: str) -> str:
+        pruned[reason] = pruned.get(reason, 0) + 1
+        return reason
+
+    for d, m in mesh_shapes:
+        if m == 1:
+            # All recipes collapse at m=1 — one canonical pure-DP entry.
+            recs: List[Dict[str, str]] = [{}]
+        else:
+            # The all-replicated recipe at m>1 is strictly dominated by
+            # (d*m, 1): same per-layer math on fewer rows per shard.
+            recs = [r for r in recipes if _is_sharded(r)]
+        for recipe in recs:
+            stem = space.stem if (recipe and space.stem in recipe) else None
+            for zero in zero_options:
+                row = {"mesh_shape": [d, m], "recipe": recipe,
+                       "stem": stem, "zero": bool(zero), "pruned": None}
+                candidates.append(row)
+                if global_batch % d:
+                    row["pruned"] = note("batch")
+                    row["detail"] = (f"global batch {global_batch} not "
+                                     f"divisible by d={d}")
+                    continue
+                try:
+                    closed, plan = trace_candidate(
+                        model_name, (d, m),
+                        recipe=recipe if recipe else None, stem=stem,
+                        zero=zero, global_batch=global_batch)
+                except ValueError as e:
+                    row["pruned"] = note("divisibility")
+                    row["detail"] = str(e).splitlines()[0]
+                    continue
+                row.update(price_closed(closed, coefficients))
+                errors = audit_candidate(
+                    f"{model_name}@{d}x{m}", closed, plan=plan, zero=zero)
+                if errors:
+                    row["pruned"] = note("audit")
+                    row["detail"] = "; ".join(errors)
+                    continue
+                if (hbm_budget_bytes is not None
+                        and row["peak_live_bytes"] > hbm_budget_bytes):
+                    row["pruned"] = note("hbm")
+                    row["detail"] = (f"peak {row['peak_live_bytes']} B > "
+                                     f"budget {hbm_budget_bytes} B")
+                    continue
+                if log is not None:
+                    log(f"  {d}x{m} zero={int(zero)} "
+                        f"{recipe_summary(recipe, space)} -> "
+                        f"{row['predicted_ms']:.3f} ms/shard")
+
+    alive = [r for r in candidates if r["pruned"] is None]
+    if not alive:
+        raise ValueError(
+            f"auto-plan search for {model_name!r} pruned every candidate "
+            f"({dict(sorted(pruned.items()))}); relax the HBM budget or "
+            "the mesh constraints")
+    rank = lambda r: (r["predicted_ms"], r["peak_live_bytes"],  # noqa: E731
+                      _candidate_key(r["mesh_shape"], r["recipe"],
+                                     r["zero"]))
+    alive.sort(key=rank)
+    candidates.sort(key=lambda r: (r["pruned"] is not None,
+                                   rank(r) if r["pruned"] is None
+                                   else (0.0, 0, _candidate_key(
+                                       r["mesh_shape"], r["recipe"],
+                                       r["zero"]))))
+    best = alive[0]
+    doc = {
+        "format_version": PLAN_FORMAT_VERSION,
+        "kind": PLAN_KIND,
+        "model": model_name,
+        "mesh_shape": best["mesh_shape"],
+        "recipe": best["recipe"],
+        "stem": best["stem"],
+        "zero": best["zero"],
+        "global_batch": int(global_batch),
+        "predicted_ms_per_step": best["predicted_ms"],
+        "flops": best["flops"],
+        "bytes": best["bytes"],
+        "collective_payload_bytes": best["collective_payload_bytes"],
+        "peak_live_bytes": best["peak_live_bytes"],
+        "coefficients": coefficients,
+        "search": {
+            "total_devices": int(total_devices),
+            "mesh_shapes": [list(s) for s in mesh_shapes],
+            "hbm_budget_bytes": hbm_budget_bytes,
+            "zero_options": [bool(z) for z in zero_options],
+            "candidates_considered": len(candidates),
+            "candidates_alive": len(alive),
+            "pruned": dict(sorted(pruned.items())),
+        },
+    }
+    return SearchResult(doc=doc, candidates=candidates, pruned=pruned)
+
+
+def plan_doc_dumps(doc: dict) -> str:
+    """The canonical serialized form — sorted keys, trailing newline —
+    the determinism contract (same inputs -> bit-identical bytes)."""
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def validate_plan_doc(doc: dict) -> None:
+    """Schema check, raising ``ValueError`` with every violation at once
+    (the tp/plan.py error style) — run on load AND by the CI smoke on
+    the emitted file."""
+    errors = []
+    if not isinstance(doc, dict):
+        raise ValueError(f"auto-plan doc must be a JSON object, got "
+                         f"{type(doc).__name__}")
+    if doc.get("kind") != PLAN_KIND:
+        errors.append(f"  kind: expected {PLAN_KIND!r}, got "
+                      f"{doc.get('kind')!r}")
+    if doc.get("format_version") != PLAN_FORMAT_VERSION:
+        errors.append(f"  format_version: expected {PLAN_FORMAT_VERSION}, "
+                      f"got {doc.get('format_version')!r}")
+    if not isinstance(doc.get("model"), str) or not doc.get("model"):
+        errors.append("  model: expected a non-empty string")
+    ms = doc.get("mesh_shape")
+    if (not isinstance(ms, list) or len(ms) != 2
+            or not all(isinstance(v, int) and v >= 1 for v in ms)):
+        errors.append(f"  mesh_shape: expected [d, m] of positive ints, "
+                      f"got {ms!r}")
+    recipe = doc.get("recipe")
+    if not isinstance(recipe, dict):
+        errors.append(f"  recipe: expected a layer->style object, got "
+                      f"{type(recipe).__name__}")
+    else:
+        from .plan import RECIPE_STYLES
+        bad = {k: v for k, v in recipe.items() if v not in RECIPE_STYLES}
+        if bad:
+            errors.append(f"  recipe: unknown styles {bad}; expected one "
+                          f"of {RECIPE_STYLES}")
+    stem = doc.get("stem")
+    if stem is not None and (not isinstance(recipe, dict)
+                             or stem not in recipe):
+        errors.append(f"  stem: {stem!r} is not a recipe layer")
+    if not isinstance(doc.get("zero"), bool):
+        errors.append(f"  zero: expected a bool, got {doc.get('zero')!r}")
+    if errors:
+        raise ValueError("invalid auto-plan doc:\n" + "\n".join(errors))
+
+
+def read_plan_doc(path: str) -> dict:
+    """Load + schema-validate a plan doc from disk."""
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    validate_plan_doc(doc)
+    return doc
+
+
+def plan_from_doc(doc: dict, params, batch_stats=None):
+    """Resolve a plan doc against LIVE params — the auto analogue of
+    ``plan_for_model``, and the same validation: the recipe re-resolves
+    against the actual param pytree, so a doc that drifted from the
+    model (renamed layer, indivisible dim) fails loudly at startup,
+    exactly like a drifted hand recipe.
+
+    Returns a ``TPPlan``, or ``None`` for a trivial doc (no sharded
+    layer — pure data parallelism; the caller runs the plain builders
+    on the doc's mesh shape)."""
+    from .plan import is_trivial, plan_for_model
+    validate_plan_doc(doc)
+    m = int(doc["mesh_shape"][1])
+    if not doc["recipe"] or not _is_sharded(doc["recipe"]):
+        return None
+    plan = plan_for_model(doc["model"], params, batch_stats,
+                          model_size=m, recipe=doc["recipe"],
+                          stem=doc.get("stem"))
+    return None if is_trivial(plan) else plan
+
+
+def recipe_summary(recipe: Dict[str, str],
+                   space: Optional[SearchSpace] = None) -> str:
+    """Compact per-layer style string in network order — ``ccrr...``
+    with ``c``=column, ``r``=row, ``-``=replicated; ``dp`` for the
+    empty (pure data-parallel) recipe."""
+    layers = space.layers if space is not None else tuple(recipe)
+    if not recipe:
+        return "dp"
+    return "".join(_STYLE_LETTER.get(recipe.get(p, "replicated"), "?")
+                   for p in layers)
+
+
+def format_search_table(result: SearchResult, model_name: str) -> str:
+    """The human-readable candidate table ``--search`` prints: ranked
+    survivors first, pruned candidates with their reason after.  First
+    line is the schema anchor CI greps for."""
+    space = search_space_for(model_name)
+    doc = result.doc
+    lines = [f"auto-plan search: {model_name} | "
+             f"devices={doc['search']['total_devices']} | "
+             f"candidates={doc['search']['candidates_considered']} "
+             f"(alive {doc['search']['candidates_alive']})"]
+    cols = ("mesh", "recipe", "zero", "pred ms/shard", "peak MiB", "status")
+    body = []
+    for row in result.candidates:
+        d, m = row["mesh_shape"]
+        status = f"pruned: {row['pruned']}" if row["pruned"] else "ok"
+        if row is result.candidates[0] and not row["pruned"]:
+            status = "CHOSEN"
+        pred = (f"{row['predicted_ms']:.3f}"
+                if row.get("predicted_ms") is not None else "-")
+        peak = (f"{row['peak_live_bytes'] / 2**20:.1f}"
+                if row.get("peak_live_bytes") is not None else "-")
+        body.append((f"{d}x{m}", recipe_summary(row["recipe"], space),
+                     "on" if row["zero"] else "off", pred, peak, status))
+    widths = [max(len(c), *(len(r[i]) for r in body))
+              for i, c in enumerate(cols)]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    lines.append(fmt.format(*cols))
+    lines += [fmt.format(*row) for row in body]
+    if space.layers:
+        lines.append("recipe letters (network order): "
+                     + ", ".join(space.layers))
+    lines.append(f"chosen: mesh {doc['mesh_shape'][0]}x"
+                 f"{doc['mesh_shape'][1]} zero="
+                 f"{'on' if doc['zero'] else 'off'} "
+                 f"{recipe_summary(doc['recipe'], space)} | predicted "
+                 f"{doc['predicted_ms_per_step']:.3f} ms/shard | peak "
+                 f"{doc['peak_live_bytes'] / 2**20:.1f} MiB/shard")
+    return "\n".join(lines)

@@ -39,6 +39,12 @@ from ..mesh import DATA_AXIS, MODEL_AXIS, model_axis_size
 _MODULE_FOR = {"resnet18": "resnet"}
 
 STYLES = ("column", "row")
+# Styles an EXPLICIT recipe (the auto-plan search's plan-as-data form,
+# parallel/tp/autoplan.py) may assign per layer.  "replicated" is the
+# explicit no-sharding choice: matched leaves keep P() specs, but the layer
+# still appears in ``plan.layers`` so the recipe round-trips through JSON
+# unchanged.  Hand TP_RECIPEs simply omit layers they leave replicated.
+RECIPE_STYLES = STYLES + ("replicated",)
 
 
 class TPPlan(NamedTuple):
@@ -103,15 +109,45 @@ def _leaf_spec(style: str, ndim: int) -> P:
 
 
 def plan_for_model(model_name: str, params, batch_stats=None, *,
-                   model_size: int) -> TPPlan:
+                   model_size: int, recipe=None, stem=None) -> TPPlan:
     """Resolve ``model_name``'s TP_RECIPE against its live param pytree.
+
+    ``recipe``/``stem`` override the model module's declarations with an
+    explicit per-layer mapping (the auto-plan path,
+    parallel/tp/autoplan.py) — same validation, so a searched plan obeys
+    exactly the divisibility/drift rules a hand recipe does.  An override
+    may also assign ``"replicated"`` explicitly (RECIPE_STYLES).
 
     Raises ``ValueError`` when the model has no recipe, a rule matches no
     parameter subtree, or any sharded dimension does not divide by
     ``model_size`` — every violation in one message, by leaf path."""
     if model_size < 1:
         raise ValueError(f"model_size must be >= 1, got {model_size}")
-    recipe, stem = _recipe_for(model_name)
+    if recipe is None:
+        recipe, stem = _recipe_for(model_name)
+    else:
+        recipe = dict(recipe)
+        bad = [s for s in recipe.values() if s not in RECIPE_STYLES]
+        if bad:
+            raise ValueError(
+                f"unknown TP styles {bad} in explicit recipe for "
+                f"{model_name!r}; expected one of {RECIPE_STYLES}")
+        if stem is not None and stem not in recipe:
+            raise ValueError(
+                f"explicit stem {stem!r} is not a recipe rule; the stem "
+                f"must name one of {list(recipe)}")
+        # Canonicalize to network order: ``plan.layers`` order IS the
+        # module TP_RECIPE's declaration order, but an explicit recipe
+        # round-tripped through a sorted-keys plan doc (tp/autoplan.py)
+        # arrives alphabetical.  Re-key by the model's declared order so
+        # a searched plan and the hand plan it reproduces are EQUAL,
+        # not merely equivalent; layers the module doesn't declare keep
+        # their given order after the declared ones.
+        mod = importlib.import_module(
+            f"ddp_tpu.models.{_MODULE_FOR.get(model_name, model_name)}")
+        declared = tuple(getattr(mod, "TP_RECIPE", None) or ())
+        recipe = {**{k: recipe[k] for k in declared if k in recipe},
+                  **{k: v for k, v in recipe.items() if k not in declared}}
     leaves: List[Tuple[str, Any]] = []
     _walk(params, "", leaves)
     matched = set()
@@ -124,7 +160,8 @@ def plan_for_model(model_name: str, params, batch_stats=None, *,
                 style, _ = s, matched.add(prefix)
                 break
         shape = tuple(np.shape(leaf))
-        spec = P() if style is None else _leaf_spec(style, len(shape))
+        spec = (P() if style in (None, "replicated")
+                else _leaf_spec(style, len(shape)))
         for dim, name in enumerate(spec):
             if name == MODEL_AXIS and shape[dim] % model_size:
                 errors.append(
@@ -201,6 +238,29 @@ def expected_collectives(plan: TPPlan, *, backward: bool) -> Dict[str, int]:
     bwd = (n_col - elided) if backward else 0
     return {"psum_model_fwd": n_row, "psum_model_bwd": bwd,
             "psum_model": n_row + bwd, "elided_stem_psum": elided}
+
+
+def is_trivial(plan: TPPlan) -> bool:
+    """True when the plan shards nothing (no column/row layer): the
+    program it implies is exactly the 1-D data-parallel one.  Callers (the
+    auto-plan loader, train/step.py's wiring) run the plain step builders
+    for such plans — which is how a model with no ``tp_axis`` forward can
+    still carry a searched all-replicated plan."""
+    return all(s not in STYLES for _, s in plan.layers)
+
+
+def recipe_override(plan: TPPlan):
+    """The ``tp_recipe`` kwarg this plan implies for ``model.apply``:
+    ``None`` when the plan IS the model module's own TP_RECIPE/TP_STEM
+    (apply's default — hand plans keep tracing byte-identically, with no
+    extra kwarg), the explicit per-layer mapping otherwise (auto plans)."""
+    try:
+        recipe, stem = _recipe_for(plan.model_name)
+    except ValueError:
+        return dict(plan.layers)
+    if dict(plan.layers) == dict(recipe) and plan.stem == stem:
+        return None
+    return dict(plan.layers)
 
 
 def state_shardings(plan: TPPlan, mesh: Mesh, *, zero: bool = False):

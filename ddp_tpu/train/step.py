@@ -136,7 +136,7 @@ def make_loss_and_grads(model, compute_dtype=None, sync_bn: bool = False):
 
 
 def make_loss_and_grads_tp(model, data_size: int, compute_dtype=None,
-                           sync_bn: bool = False):
+                           sync_bn: bool = False, tp_recipe=None):
     """The tensor-parallel replicated-update gradient core: same signature
     and contract as :func:`make_loss_and_grads`, for a 2-D (data × model)
     mesh with params sharded per the tp plan (parallel/tp/plan.py).
@@ -154,10 +154,12 @@ def make_loss_and_grads_tp(model, data_size: int, compute_dtype=None,
     Model-sharded leaves get their own slice's gradient (their data-axis
     replicas agree; no ``model``-axis gradient collective exists — axis
     correctness is the whole game, tests/test_tp.py pins it bitwise at
-    m=1)."""
+    m=1).  ``tp_recipe`` overrides the model module's TP_RECIPE with an
+    explicit per-layer mapping (auto plans, parallel/tp/autoplan.py)."""
     from .zero import _make_local_grads
     local_grads = _make_local_grads(model, data_size, compute_dtype,
-                                    sync_bn, tp_axis=MODEL_AXIS)
+                                    sync_bn, tp_axis=MODEL_AXIS,
+                                    tp_recipe=tp_recipe)
 
     def loss_and_grads(params, batch_stats, images, labels, rng):
         loss, new_stats, grads = local_grads(params, batch_stats, images,
@@ -309,15 +311,21 @@ def make_step_wiring(model, mesh: Mesh, compute_dtype, sync_bn, plan):
     ``data``, replicated over ``model``); with a plan the state specs
     follow its per-leaf PartitionSpecs and ``check_vma=False`` because
     the TP program's collectives are all explicit with their own
-    transposes (the same regime train/zero.py documents)."""
-    if plan is None:
+    transposes (the same regime train/zero.py documents).  A TRIVIAL plan
+    (no column/row layer — an auto plan that searched its way to pure data
+    parallelism, parallel/tp/autoplan.py) wires exactly the plain path:
+    the program it implies IS the 1-D one, and models without a
+    ``tp_axis`` forward must still run under it."""
+    from ..parallel.tp.plan import (is_trivial, recipe_override,
+                                    state_shardings, state_specs)
+    if plan is None or is_trivial(plan):
         core = make_loss_and_grads(model, compute_dtype=compute_dtype,
                                    sync_bn=sync_bn)
         return core, P(), replicated_sharding(mesh), {}
-    from ..parallel.tp.plan import state_shardings, state_specs
     core = make_loss_and_grads_tp(model, data_axis_size(mesh),
                                   compute_dtype=compute_dtype,
-                                  sync_bn=sync_bn)
+                                  sync_bn=sync_bn,
+                                  tp_recipe=recipe_override(plan))
     return (core, state_specs(plan), state_shardings(plan, mesh),
             {"check_vma": False})
 
@@ -400,7 +408,8 @@ def make_train_step_accum(model, sgd_config: sgd_lib.SGDConfig,
                    out_shardings=(st_sh, replicated_sharding(mesh)))
 
 
-def make_eval_apply(model, compute_dtype=None, tp_axis=None):
+def make_eval_apply(model, compute_dtype=None, tp_axis=None,
+                    tp_recipe=None):
     """The per-shard eval-mode forward — ``fn(params, batch_stats, images)
     -> logits`` with BN in running-stats mode (``model.eval()`` semantics,
     singlegpu.py:189) and the on-device uint8 ToTensor scaling.
@@ -410,7 +419,8 @@ def make_eval_apply(model, compute_dtype=None, tp_axis=None):
     engine's logits program, ddp_tpu/serve/) both trace exactly this
     function, so served predictions cannot drift from ``evaluate()``.
     ``tp_axis`` threads the tensor-parallel forward through (model-sharded
-    params, row-parallel psums over that axis — parallel/tp/).
+    params, row-parallel psums over that axis — parallel/tp/);
+    ``tp_recipe`` overrides the module's TP_RECIPE for auto plans.
     """
 
     def apply_fn(params, batch_stats, images):
@@ -418,10 +428,23 @@ def make_eval_apply(model, compute_dtype=None, tp_axis=None):
                                 _as_input(images, compute_dtype),
                                 train=False, compute_dtype=compute_dtype,
                                 **({} if tp_axis is None
-                                   else {"tp_axis": tp_axis}))
+                                   else {"tp_axis": tp_axis}),
+                                **({} if tp_recipe is None
+                                   else {"tp_recipe": tp_recipe}))
         return logits
 
     return apply_fn
+
+
+def _eval_wiring(plan):
+    """``(param specs, stats specs, tp_axis, tp_recipe, shard_map extras)``
+    for the two eval-side builders — the same plan/trivial-plan decision
+    :func:`make_step_wiring` makes for the train side."""
+    from ..parallel.tp.plan import is_trivial, recipe_override
+    if plan is None or is_trivial(plan):
+        return P(), P(), None, None, {}
+    return (plan.param_specs, plan.stats_specs, MODEL_AXIS,
+            recipe_override(plan), {"check_vma": False})
 
 
 def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
@@ -449,12 +472,9 @@ def make_eval_forward(model, mesh: Mesh, compute_dtype=None,
     sharded on ``data`` exactly as in the 1-D case (each model shard holds
     the full post-psum logits for its data rows).
     """
-    if plan is None:
-        p_specs, s_specs, tp_axis, extra = P(), P(), None, {}
-    else:
-        p_specs, s_specs = plan.param_specs, plan.stats_specs
-        tp_axis, extra = MODEL_AXIS, {"check_vma": False}
-    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis)
+    p_specs, s_specs, tp_axis, tp_recipe, extra = _eval_wiring(plan)
+    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis,
+                               tp_recipe=tp_recipe)
 
     def _shard_body(params, batch_stats, images):
         if on_trace is not None:
@@ -483,12 +503,9 @@ def make_eval_step(model, mesh: Mesh, compute_dtype=None, plan=None):
     shards the params over ``model``; the counters still reduce over
     ``data`` only (every model shard computes the same post-psum logits).
     """
-    if plan is None:
-        p_specs, s_specs, tp_axis, extra = P(), P(), None, {}
-    else:
-        p_specs, s_specs = plan.param_specs, plan.stats_specs
-        tp_axis, extra = MODEL_AXIS, {"check_vma": False}
-    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis)
+    p_specs, s_specs, tp_axis, tp_recipe, extra = _eval_wiring(plan)
+    apply_fn = make_eval_apply(model, compute_dtype, tp_axis=tp_axis,
+                               tp_recipe=tp_recipe)
 
     def _shard_body(params, batch_stats, batch):
         logits = apply_fn(params, batch_stats, batch["image"])

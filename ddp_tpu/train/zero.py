@@ -186,7 +186,7 @@ def pytree_to_opt_shard(momentum_pytree, mesh: Mesh,
 
 
 def _make_local_grads(model, R: int, compute_dtype=None,
-                      sync_bn: bool = False, tp_axis=None):
+                      sync_bn: bool = False, tp_axis=None, tp_recipe=None):
     """Per-shard forward/backward of the collective-free LOCAL objective
     ``ce_sum/(count*R)``: its sum over the R shards is the global-mean loss
     (equal per-shard counts — the sampler padding guarantee,
@@ -208,6 +208,11 @@ def _make_local_grads(model, R: int, compute_dtype=None,
     (data, model) device.  This core is shared by the sharded-update path
     here AND the replicated-update tp core
     (:func:`~ddp_tpu.train.step.make_loss_and_grads_tp`).
+
+    ``tp_recipe`` (auto plans, parallel/tp/autoplan.py) overrides the
+    model module's TP_RECIPE with an explicit per-layer style mapping;
+    ``None`` keeps apply's default — so hand plans trace with no extra
+    kwarg, byte-identically to before the auto path existed.
     """
 
     def local_grads(params, batch_stats, images, labels, rng):
@@ -217,7 +222,9 @@ def _make_local_grads(model, R: int, compute_dtype=None,
                 logits, new_stats = model.apply(
                     params, batch_stats, _as_input(images, compute_dtype),
                     train=True, rng=rng, compute_dtype=compute_dtype,
-                    **({} if tp_axis is None else {"tp_axis": tp_axis}))
+                    **({} if tp_axis is None else {"tp_axis": tp_axis}),
+                    **({} if tp_recipe is None
+                       else {"tp_recipe": tp_recipe}))
             ce_sum, count = cross_entropy_sum_count(logits, labels)
             return ce_sum / (count * R), (new_stats, ce_sum, count)
 
@@ -303,12 +310,18 @@ def _zero_pieces(model, mesh: Mesh, sgd_config, lr_schedule, compute_dtype,
     model's ``tp_axis`` forward under a plan, the flat-mesh size and the
     plain forward without."""
     if plan is None:
-        R = mesh.devices.size
+        # Axis-extent product, not mesh.devices.size: the auto-plan search
+        # prices this builder on a deviceless AbstractMesh
+        # (parallel/mesh.py:abstract_mesh).
+        from ..parallel.mesh import mesh_size
+        R = mesh_size(mesh)
         local_grads = _make_local_grads(model, R, compute_dtype, sync_bn)
         return R, local_grads, _make_zero_update(sgd_config, lr_schedule, R)
+    from ..parallel.tp.plan import recipe_override
     R = data_axis_size(mesh)
     local_grads = _make_local_grads(model, R, compute_dtype, sync_bn,
-                                    tp_axis=MODEL_AXIS)
+                                    tp_axis=MODEL_AXIS,
+                                    tp_recipe=recipe_override(plan))
     return R, local_grads, _make_zero_update(sgd_config, lr_schedule, R,
                                              tp=True)
 

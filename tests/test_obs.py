@@ -632,12 +632,22 @@ def test_obs_main_ledger_join(tmp_path, capsys):
                      "--json"]) == 0
     doc = json.loads(capsys.readouterr().out)
     row = {r["phase"]: r for r in doc["rows"]}["dispatch"]
-    # The @dp variant wins over @accum; median dispatch is 200 ms
-    # (0.1/0.3/0.2 s) vs 50 ms predicted x2 scale -> +100% gap.
+    # The @dp variant wins over @accum.  Each host's first dispatch span
+    # is the compile-paying call and is split out: host0's first is
+    # 100 ms and host1's ONLY span (200 ms) is its first, so
+    # first_call_ms = median(100, 200) = 150, and the steady-state
+    # median is the remaining 300 ms vs 50 ms predicted x2 scale ->
+    # +200% gap.
     assert row["program"] == "train_step@dp8"
     assert row["predicted_ms"] == pytest.approx(100.0)
-    assert row["measured_ms"] == pytest.approx(200.0)
-    assert row["gap_pct"] == pytest.approx(100.0)
+    assert row["measured_ms"] == pytest.approx(300.0)
+    assert row["gap_pct"] == pytest.approx(200.0)
+    assert row["first_call_ms"] == pytest.approx(150.0)
+    assert "first_call_only" not in row
+    # Unpriced phases get the same first-call split (data_wait ran once,
+    # so its first call is its measurement).
+    dw = {r["phase"]: r for r in doc["unpriced"]}["data_wait"]
+    assert dw["first_call_ms"] == pytest.approx(dw["measured_ms"])
     # (>1 is possible here: the sample spill is two hosts whose serial
     # lanes each tile their own wall, merged onto one clock.)
     assert doc["pred_scale"] == 2.0 and doc["serial_coverage"] > 0

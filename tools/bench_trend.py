@@ -34,7 +34,8 @@ import re
 import sys
 from typing import Dict, List, Optional, Tuple
 
-HIGHER_BETTER = ("samples/sec", "req/s", "mfu", "fraction", "accuracy")
+HIGHER_BETTER = ("samples/sec", "req/s", "mfu", "fraction", "accuracy",
+                 "speedup")
 LOWER_BETTER = ("ms", "s/flop", "s/byte", "seconds", "%", "s")
 
 
