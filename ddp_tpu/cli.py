@@ -143,6 +143,18 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "record — hot paths see the shared no-op tracer "
                         "(zero measurable step-time overhead, the "
                         "contract CI checks)")
+    p.add_argument("--inspect_port", default=None, type=int, metavar="PORT",
+                   help="Serve live run introspection over HTTP on "
+                        "127.0.0.1:PORT (rank 0; obs/inspect.py): GET "
+                        "/metrics (live registry exposition), /healthz "
+                        "(step/epoch, guard/drift/mirror/watchdog state), "
+                        "/spans (recent tracer ring), /debug/profile?"
+                        "steps=N (capture the next N steps' spans + a "
+                        "jax.profiler trace where supported; SIGUSR1 arms "
+                        "the same capture on headless boxes).  0 = an "
+                        "ephemeral port (printed at startup).  Off by "
+                        "default: no socket is bound and the run is "
+                        "bit-identical")
     p.add_argument("--log_every", default=50, type=int, metavar="N",
                    help="Emit a live telemetry record (obs/live.py: "
                         "rolling median/p90 step time, samples/sec, MFU "
@@ -829,9 +841,68 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                    if mirror is not None else "off"))
         return "\n".join(p for p in parts if p)
 
+    # /healthz snapshot — the one description of live run state, shared
+    # verbatim by the inspect endpoint and the flight recorder's bundle
+    # (a postmortem and a mid-run scrape must never disagree about what
+    # "the run's state" means).  Every read is a host-side mirror or a
+    # lock-free scrape — nothing here touches a device or blocks.
+    def _health_snapshot() -> dict:
+        snap: dict = {}
+        if trainer_ref:
+            t = trainer_ref[0]
+            snap["step"] = t._host_step
+            snap["epoch"] = t._host_epoch
+            snap["guard_last_decision"] = t._health.last_decision
+            snap["guard_restores"] = t._health.restores
+            drift = getattr(t, "_drift", None)
+            snap["drift_last_audit_step"] = (
+                drift.last_audit_step if drift is not None else None)
+            mirror = getattr(t, "_mirror", None)
+            snap["mirror_lag_epochs"] = (
+                mirror.lag_epochs() if mirror is not None else None)
+        if watchdog is not None:
+            snap["watchdog_last_beat_age_s"] = round(
+                watchdog.last_beat_age(), 3)
+            snap["watchdog_timeout_s"] = watchdog.timeout_s
+        if pstats is not None:
+            snap["prefetch"] = pstats.per_step_ms()
+        return snap
+
+    # Flight recorder (obs/blackbox.py): rank 0, needs --metrics_path for
+    # a home (the bundle lands next to the JSONL) and respects the
+    # --obs_off kill-switch like every other telemetry surface.
+    from .obs.blackbox import POSTMORTEM_BASENAME, FlightRecorder
+    recorder = None
+    if (not args.obs_off and args.metrics_path
+            and jax.process_index() == 0):
+        recorder = FlightRecorder(
+            os.path.join(
+                os.path.dirname(os.path.abspath(args.metrics_path)),
+                POSTMORTEM_BASENAME),
+            config=vars(args), tracer=tracer, context=_health_snapshot)
+        metrics.attach_recorder(recorder)
+
+    # Watchdog expiry hook: land the spill tail AND the postmortem bundle
+    # before os._exit(124).  Both are bounded (side thread + join
+    # timeout) — the expire path must reach the exit regardless of a
+    # wedged filesystem.
+    from .resilience.watchdog import WATCHDOG_EXIT_STATUS
+
+    def _on_expire() -> None:
+        if tracer.enabled:
+            _flush_spill_bounded()
+        if recorder is not None:
+            recorder.dump("watchdog_stall",
+                          exit_status=WATCHDOG_EXIT_STATUS,
+                          error="watchdog: no progress heartbeat within "
+                                f"{args.watchdog_secs}s",
+                          bounded=True)
+
     watchdog = (Watchdog(args.watchdog_secs,
                          context=_stall_context,
-                         on_expire=(_flush_spill_bounded if tracer.enabled
+                         on_expire=(_on_expire
+                                    if (tracer.enabled
+                                        or recorder is not None)
                                     else None),
                          registry=registry)
                 if args.watchdog_secs > 0 else None)
@@ -871,6 +942,43 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                          model=args.model,
                          device_kind=jax.devices()[0].device_kind,
                          prefetch_stats=pstats)
+    # In-run introspection probes (obs/inspect.py), composed into the one
+    # bounded per-step callable the trainer exposes.  The periodic .prom
+    # rewrite runs whenever the end-of-run scrape file would exist (it
+    # shares --obs_off-independence with that path: the registry always
+    # exists); the profile trigger needs live spans, so it respects the
+    # kill-switch.
+    from .obs.inspect import (InspectServer, ProfileTrigger, PromFileWriter,
+                              install_sigusr1)
+    prom_writer = None
+    if args.metrics_path and jax.process_index() == 0:
+        prom_writer = PromFileWriter(registry, args.metrics_path + ".prom",
+                                     every=max(args.log_every, 1))
+    profile_trigger = None
+    if not args.obs_off and jax.process_index() == 0:
+        profile_trigger = ProfileTrigger(
+            tracer,
+            (os.path.dirname(os.path.abspath(args.metrics_path))
+             if args.metrics_path else os.getcwd()),
+            # --profile_dir already owns the process-wide jax profiler
+            # for the whole run — a second start_trace would raise.  The
+            # CPU backend is also excluded: a mid-run stop_trace there
+            # serializes minutes of host-tracing data on the training
+            # thread (measured: a 2-step capture stalled a run past its
+            # watchdog limit), so on CPU the capture is spans-only.
+            profiler_available=(not args.profile_dir
+                                and jax.default_backend() != "cpu"))
+    probes = [p.step for p in (prom_writer, profile_trigger)
+              if p is not None]
+    if args.log_every <= 0 and prom_writer is not None:
+        probes.remove(prom_writer.step)  # end-of-run write only
+    step_probe = None
+    if len(probes) == 1:
+        step_probe = probes[0]
+    elif probes:
+        def step_probe(step, _probes=tuple(probes)):
+            for p in _probes:
+                p(step)
     trainer = Trainer(model, train_loader, params, batch_stats, mesh=mesh,
                       lr_schedule=lr_schedule,
                       sgd_config=SGDConfig(lr=args.lr,
@@ -900,8 +1008,31 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       guard_action=getattr(args, "guard_action",
                                            "rollback"),
                       registry=registry,
-                      mirror=getattr(args, "mirror", None))
+                      mirror=getattr(args, "mirror", None),
+                      step_probe=step_probe)
     trainer_ref.append(trainer)
+    # The inspect server binds ONLY when --inspect_port is given (the
+    # zero-sockets contract); constructed after the trainer so /healthz
+    # describes a live object from its first request.
+    inspect_server = None
+    uninstall_sigusr1 = None
+    if args.inspect_port is not None and jax.process_index() == 0:
+        try:
+            inspect_server = InspectServer(args.inspect_port,
+                                           registry=registry, tracer=tracer,
+                                           health=_health_snapshot,
+                                           profile=profile_trigger)
+            print(f"inspect: serving /metrics /healthz /spans "
+                  f"/debug/profile on 127.0.0.1:{inspect_server.port}",
+                  file=sys.stderr)
+        except OSError as e:
+            # A taken port must not kill a training run — the run is the
+            # product, the observation surface is not.
+            print(f"WARNING: cannot bind --inspect_port "
+                  f"{args.inspect_port}: {e}; continuing without the "
+                  "inspect server", file=sys.stderr)
+    if profile_trigger is not None and jax.process_index() == 0:
+        uninstall_sigusr1 = install_sigusr1(profile_trigger)
     # Test-only fault injection drills (no-op unless DDP_TPU_FAULT is set
     # — resilience/faults.py; the subprocess drills in
     # tests/test_resilience.py drive preemption/NaN/stall through the real
@@ -957,6 +1088,26 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                 print(f"Epoch {epoch} | eval accuracy={acc:.2f}%")
                 metrics.log_eval(epoch=epoch, accuracy=acc)
 
+    # Postmortem classification for the trainer-lifetime exception wrap:
+    # the bundle names WHY the run died in the recorder's closed reason
+    # vocabulary, with the exit status the process will actually report.
+    def _dump_on_failure(err: BaseException) -> None:
+        if recorder is None or recorder.dumped is not None:
+            return
+        from .resilience.drift import DriftDetectedError
+        from .resilience.guard import LossSpikeError, NonFiniteLossError
+        from .resilience.preemption import (
+            EMERGENCY_CHECKPOINT_EXIT_STATUS, PreemptionInterrupt)
+        if isinstance(err, PreemptionInterrupt):
+            reason, status = "preemption", EMERGENCY_CHECKPOINT_EXIT_STATUS
+        elif isinstance(err, DriftDetectedError):
+            reason, status = "drift_abort", 1
+        elif isinstance(err, (NonFiniteLossError, LossSpikeError)):
+            reason, status = "guard_abort", 1
+        else:
+            reason, status = "crash", 1
+        recorder.dump(reason, exit_status=status, error=repr(err))
+
     start = time.time()
     if args.profile_dir:
         jax.profiler.start_trace(args.profile_dir)
@@ -969,6 +1120,12 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
             trainer.train(
                 args.total_epochs,
                 epoch_callback=_epoch_callback if args.eval_every else None)
+        except BaseException as err:
+            # Flight-recorder dump BEFORE the error propagates into
+            # run()'s teardown (which may hard-exit on multi-host): the
+            # bundle is the black box an abnormal exit leaves behind.
+            _dump_on_failure(err)
+            raise
         finally:
             if watchdog is not None:
                 watchdog.stop()
@@ -1005,13 +1162,13 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         # is line-buffered).
         metrics.close()
         # End-of-run scrape file: the registry's final exposition, next
-        # to the metrics JSONL (rank 0 — same gate as the JSONL itself).
-        if args.metrics_path and jax.process_index() == 0:
-            prom = args.metrics_path + ".prom"
-            try:
-                with open(prom, "w") as f:
-                    f.write(registry.exposition())
-            except OSError as e:
-                print(f"WARNING: cannot write metrics scrape file "
-                      f"{prom!r}: {e}", file=sys.stderr)
+        # to the metrics JSONL (rank 0 — same gate as the JSONL itself;
+        # crash-atomic like every periodic rewrite, so a scraper racing
+        # the run's death never reads a torn exposition).
+        if prom_writer is not None:
+            prom_writer.write()
+        if uninstall_sigusr1 is not None:
+            uninstall_sigusr1()
+        if inspect_server is not None:
+            inspect_server.close()
     return accuracy

@@ -14,19 +14,28 @@ the joined batch's engine stages).  ``--ledger CALIB.json`` joins the
 spill against a ``bench.py --calibrate_cost`` record into the
 predicted-vs-measured efficiency ledger (obs/ledger.py).
 
+``--postmortem BUNDLE.json`` is a separate mode (no spill needed): it
+schema-validates a flight-recorder bundle (obs/blackbox.py, dumped as
+``postmortem.json`` next to the metrics file on every abnormal exit) and
+renders the human autopsy — reason, exit status, error, the health
+snapshot at death, the resilience-event timeline, and the last completed
+spans.  A missing or torn bundle exits 2 with a one-line diagnosis.
+
 Multi-host runs spill one file per host (``--trace_spill`` path plus
 ``.hostN`` suffixes); pass them all — the terminal report prints one
 section per host (hosts' clocks are independent and each host's serial
 lanes tile its own wall), and the Perfetto export lays the hosts side
 by side (one process per host).
 
-Exit status: 0 on success; 2 on an unusable spill (missing file, no
-spans, or a mixed train+serve spill — each diagnosed in one line).
+Exit status: 0 on success; 2 on an unusable spill or bundle (missing
+file, no spans, a mixed train+serve spill, or a torn/invalid postmortem
+— each diagnosed in one line).
 
 Usage:
     python -m ddp_tpu.obs trace_spill.jsonl [more_spills...]
         [--perfetto trace.json] [--top 10] [--bins 12]
         [--requests] [--ledger CALIB.json [--ledger_scale N]]
+    python -m ddp_tpu.obs --postmortem postmortem.json [--json]
 """
 from __future__ import annotations
 
@@ -64,9 +73,14 @@ def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m ddp_tpu.obs",
         description=__doc__.splitlines()[0])
-    p.add_argument("spill", nargs="+",
+    p.add_argument("spill", nargs="*",
                    help="Span spill file(s) from --trace_spill (one per "
                         "host; pass all of a run's files to merge)")
+    p.add_argument("--postmortem", default=None, metavar="BUNDLE.json",
+                   help="Render a flight-recorder postmortem bundle "
+                        "(obs/blackbox.py) instead of a spill report; "
+                        "missing/torn bundles exit 2 with a one-line "
+                        "diagnosis")
     p.add_argument("--perfetto", default=None, metavar="OUT.json",
                    help="Also export a schema-validated Chrome/Perfetto "
                         "trace_event JSON (open in ui.perfetto.dev)")
@@ -89,6 +103,34 @@ def main(argv: Optional[list] = None) -> int:
                    help="With --requests/--ledger: emit JSON instead of "
                         "the terminal table")
     args = p.parse_args(argv)
+    if args.postmortem is not None:
+        # Bundle mode needs no spill; diagnose every unusable shape in
+        # one line (the operator is mid-incident — no tracebacks).
+        from .blackbox import format_postmortem, validate_postmortem
+        try:
+            with open(args.postmortem) as f:
+                doc = json.load(f)
+        except OSError as e:
+            print(f"cannot read postmortem bundle: {e} — did the run "
+                  "exit cleanly (no bundle is written on status 0)?",
+                  file=sys.stderr)
+            return 2
+        except json.JSONDecodeError as e:
+            print(f"torn postmortem bundle {args.postmortem}: {e} — the "
+                  "writer is crash-atomic, so a torn file means a "
+                  "partial copy or truncation in transit",
+                  file=sys.stderr)
+            return 2
+        try:
+            validate_postmortem(doc)
+        except ValueError as e:
+            print(f"invalid postmortem bundle {args.postmortem}: {e}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(doc) if args.as_json else format_postmortem(doc))
+        return 0
+    if not args.spill:
+        p.error("a spill file is required (or use --postmortem)")
     try:
         spans = read_spill(args.spill)
     except OSError as e:

@@ -1,0 +1,305 @@
+"""Live run introspection — rank-0 HTTP endpoints + periodic .prom + the
+on-demand profile trigger.
+
+A running training job used to be a black box until its end-of-run
+artifacts landed.  This module is the in-run observation surface, all of
+it off by default and costing nothing when off (the same kill-switch
+contract as ``--obs_off``):
+
+- :class:`InspectServer` — a stdlib ``ThreadingHTTPServer`` on
+  ``--inspect_port`` (rank 0, loopback) serving
+
+  * ``GET /metrics``  — the live registry exposition (strict v0.0.4
+    text, round-trips ``obs.registry.parse_exposition``),
+  * ``GET /healthz``  — step/epoch, last guard decision, last
+    drift-audit step, mirror lag, prefetch occupancy, watchdog
+    last-beat age (the same snapshot the flight recorder bundles),
+  * ``GET /spans``    — the tracer's completed-span ring as JSON,
+  * ``GET /debug/profile?steps=N`` — arm the profile trigger;
+
+- :class:`ProfileTrigger` — captures the NEXT ``N`` steps' spans (plus a
+  ``jax.profiler`` trace directory when the backend supports it and no
+  ``--profile_dir`` trace already owns the profiler) and writes one
+  ``profile_capture_<step>.json`` artifact.  Armed over HTTP or by
+  SIGUSR1 (:func:`install_sigusr1`) for headless boxes;
+
+- :class:`PromFileWriter` — rewrites ``<metrics_path>.prom`` every
+  ``--log_every`` optimizer steps so file-based scrapers see a live run,
+  each rewrite crash-atomic via :func:`obs.blackbox.atomic_write_text`
+  (temp + fsync + ``os.replace``): a concurrent scrape reads either the
+  previous complete exposition or the new one, never a torn file.
+
+Nothing here touches the training hot path beyond one bounded callable
+per optimizer step (the trainer's ``step_probe``), and none of it is
+constructed at all unless the flags ask for it — with ``--inspect_port``
+unset the run binds no socket and behaves bit-identically.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional
+from urllib.parse import parse_qs, urlsplit
+
+from .blackbox import atomic_write_text
+from .registry import CONTENT_TYPE, MetricsRegistry
+
+# SIGUSR1 has no query string to carry N — capture a fixed, useful window.
+SIGUSR1_PROFILE_STEPS = 16
+
+
+class PromFileWriter:
+    """Periodic crash-atomic ``<metrics_path>.prom`` rewrite.
+
+    ``step(n)`` is the trainer's per-step probe: it rewrites when ``n``
+    crosses the ``every`` cadence (same cadence as the live-stats
+    emitter).  ``write()`` forces one — the end-of-run path uses it so
+    the final exposition always lands even when the run dies between
+    cadence points.  Failures warn once and disable the writer: a
+    read-only disk must not fail a step, and must not warn per step."""
+
+    def __init__(self, registry: MetricsRegistry, path: str,
+                 every: int) -> None:
+        self._registry = registry
+        self.path = path
+        self._every = max(int(every), 1)
+        self._last_written = -1
+        self._dead = False
+
+    def step(self, step: int) -> None:
+        if self._dead or step < 0:
+            return
+        if step // self._every != self._last_written // self._every:
+            self._last_written = step
+            self.write()
+
+    def write(self) -> None:
+        if self._dead:
+            return
+        try:
+            atomic_write_text(self.path, self._registry.exposition())
+        except OSError as e:
+            print(f"WARNING: cannot write metrics scrape file "
+                  f"{self.path!r} ({e}); periodic .prom rewrite disabled",
+                  file=sys.stderr)
+            self._dead = True
+
+
+class ProfileTrigger:
+    """Arm-and-capture profiler for the next N optimizer steps.
+
+    ``request(n)`` (HTTP handler thread or SIGUSR1 handler) only sets an
+    integer under a lock; the capture itself starts and ends on the
+    training loop thread inside ``step()``, so the jax profiler start /
+    stop bracket and the span-window read happen where the work happens.
+    ``profiler_available=False`` (cli passes it when ``--profile_dir``
+    already owns the process-wide profiler) keeps the span capture and
+    skips the trace dir."""
+
+    def __init__(self, tracer, out_dir: str, *,
+                 profiler_available: bool = True) -> None:
+        import os
+        self._tracer = tracer
+        self._out_dir = out_dir or os.getcwd()
+        self._profiler_available = profiler_available
+        self._lock = threading.Lock()
+        self._pending = 0      # analysis: shared-under(_lock)
+        self._remaining = 0    # active capture's steps left
+        self._t0 = 0.0
+        self._start_step = 0
+        self._trace_dir: Optional[str] = None
+        self.captures: List[str] = []  # artifact paths, oldest first
+
+    def request(self, steps: int) -> None:
+        steps = max(int(steps), 1)
+        with self._lock:
+            if self._pending == 0 and self._remaining == 0:
+                self._pending = steps
+
+    @property
+    def armed(self) -> bool:
+        """True while a capture is requested or in flight."""
+        with self._lock:
+            return self._pending > 0 or self._remaining > 0
+
+    def step(self, step: int) -> None:
+        start, finish = 0, False
+        with self._lock:
+            if self._remaining > 0:
+                self._remaining -= 1
+                finish = self._remaining == 0
+            elif self._pending > 0:
+                start = self._pending
+                self._pending = 0
+                self._remaining = start
+        if start:
+            self._start(step, start)  # counts down from the NEXT step
+        elif finish:
+            self._finish(step)
+
+    def _start(self, step: int, steps: int) -> None:
+        import os
+        self._start_step = step
+        self._t0 = self._tracer.now() if getattr(
+            self._tracer, "enabled", False) else 0.0
+        self._trace_dir = None
+        if self._profiler_available:
+            trace_dir = os.path.join(self._out_dir,
+                                     f"profile_trace_step{step}")
+            try:
+                import jax
+                jax.profiler.start_trace(trace_dir)
+                self._trace_dir = trace_dir
+            except Exception as e:  # backend without profiler support
+                print(f"note: jax profiler trace unavailable ({e}); "
+                      "capturing spans only", file=sys.stderr)
+        print(f"profile trigger: capturing the next {steps} step(s) "
+              f"from step {step}", file=sys.stderr)
+
+    def _finish(self, step: int) -> None:
+        import os
+        if self._trace_dir is not None:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:
+                self._trace_dir = None
+        spans = (self._tracer.spans_since(self._t0)
+                 if getattr(self._tracer, "enabled", False) else [])
+        doc = {"schema": "profile_capture/1",
+               "start_step": self._start_step, "end_step": step,
+               "trace_dir": self._trace_dir, "spans": spans}
+        path = os.path.join(self._out_dir,
+                            f"profile_capture_step{self._start_step}.json")
+        try:
+            atomic_write_text(path, json.dumps(doc, indent=1) + "\n")
+            self.captures.append(path)
+            print(f"profile trigger: wrote {path}"
+                  + (f" (trace: {self._trace_dir})" if self._trace_dir
+                     else ""), file=sys.stderr)
+        except OSError as e:
+            print(f"WARNING: profile capture write failed: {e}",
+                  file=sys.stderr)
+
+
+def install_sigusr1(trigger: ProfileTrigger,
+                    steps: int = SIGUSR1_PROFILE_STEPS
+                    ) -> Optional[Callable[[], None]]:
+    """SIGUSR1 arms the profile trigger (headless boxes with no port
+    open to curl).  Returns an uninstaller restoring the previous
+    handler, or None when not on the main thread (signal.signal is
+    main-thread-only — embedded callers keep their own handlers)."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+    prev = signal.signal(signal.SIGUSR1,
+                         lambda signum, frame: trigger.request(steps))
+
+    def _uninstall() -> None:
+        signal.signal(signal.SIGUSR1, prev)
+
+    return _uninstall
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # One in-run server per process; request logging to stderr would
+    # interleave with training prints — drop it.
+    def log_message(self, fmt, *args):  # noqa: A002
+        pass
+
+    def _send(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-write; nothing to clean up
+
+    def _send_json(self, doc, code: int = 200) -> None:
+        self._send(code, json.dumps(doc, sort_keys=True) + "\n",
+                   "application/json; charset=utf-8")
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        srv: "InspectServer" = self.server.inspect  # type: ignore[attr-defined]
+        url = urlsplit(self.path)
+        try:
+            if url.path == "/metrics":
+                self._send(200, srv.registry.exposition(), CONTENT_TYPE)
+            elif url.path == "/healthz":
+                self._send_json(srv.health_snapshot())
+            elif url.path == "/spans":
+                tracer = srv.tracer
+                spans = (tracer.spans_since(0.0)
+                         if getattr(tracer, "enabled", False) else [])
+                self._send_json({"spans": spans})
+            elif url.path == "/debug/profile":
+                if srv.profile is None:
+                    self._send_json({"error": "profile trigger off "
+                                     "(--obs_off run?)"}, code=503)
+                    return
+                q = parse_qs(url.query)
+                try:
+                    steps = int(q.get("steps", ["8"])[0])
+                except ValueError:
+                    self._send_json({"error": "steps must be an int"},
+                                    code=400)
+                    return
+                srv.profile.request(steps)
+                self._send_json({"armed": True, "steps": max(steps, 1),
+                                 "out_dir": srv.profile._out_dir})
+            else:
+                self._send_json({"error": f"no route {url.path}",
+                                 "routes": ["/metrics", "/healthz",
+                                            "/spans", "/debug/profile"]},
+                                code=404)
+        except Exception as e:
+            # An endpoint bug must not take down the scrape loop, let
+            # alone the run — report it to the caller instead.
+            self._send_json({"error": repr(e)}, code=500)
+
+
+class InspectServer:
+    """The rank-0 in-run HTTP server.  Constructed ONLY when
+    ``--inspect_port`` is given (the off path binds no socket); serves on
+    loopback from a daemon thread, so a wedged run's endpoints stay
+    readable right up to the watchdog's ``os._exit``."""
+
+    def __init__(self, port: int, *, registry: MetricsRegistry, tracer,
+                 health: Callable[[], dict],
+                 profile: Optional[ProfileTrigger] = None,
+                 host: str = "127.0.0.1") -> None:
+        self.registry = registry
+        self.tracer = tracer
+        self._health = health
+        self.profile = profile
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.inspect = self  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.5},
+            daemon=True, name="obs-inspect")
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        """The actual bound port (``--inspect_port 0`` = ephemeral)."""
+        return int(self._httpd.server_address[1])
+
+    def health_snapshot(self) -> dict:
+        try:
+            return dict(self._health())
+        except Exception as e:
+            return {"ok": False, "error": repr(e)}
+
+    def close(self) -> None:
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except OSError:
+            pass
+        self._thread.join(timeout=3.0)

@@ -1,0 +1,316 @@
+"""Memory ledger — measured per-program memory watermarks joined against
+the static liveness predictions (the memory twin of obs/ledger.py).
+
+``analysis/liveness.py`` prices every registry program's peak live bytes
+per shard, and the auto-plan search prunes candidates on those numbers —
+but until now they were never validated against a measured watermark,
+the way the time cost model is validated by the efficiency ledger.  This
+module closes that loop:
+
+- **Predicted**: trace each program (abstract — no device memory) and
+  scale the per-shard ``peak_live_bytes`` by the shard count; on the
+  virtual CPU mesh every shard lives in ONE process, so the whole-process
+  watermark is the per-shard peak summed over devices.  Replication is
+  what makes the orderings measurable here: 1-D data parallelism holds
+  R param copies in the process, TP holds ~R/m, ZeRO holds one optimizer
+  slice instead of R — real host bytes, not annotations.
+- **Measured**: run the REAL jitted program with concrete, properly
+  placed arguments and read the runtime's own numbers — device
+  ``memory_stats()['peak_bytes_in_use']`` where the backend keeps one
+  (TPU/GPU); on this CPU box the exact per-device committed buffer
+  bytes after the step (summed over every live array's addressable
+  shards — a replicated param tree costs one full copy PER device,
+  which is precisely what the sharding claims are about) plus the
+  child-process ``ru_maxrss`` watermark (the same probe family as
+  ``ckpt_shard.HostBytesProbe``).  One program per child process:
+  ``ru_maxrss`` is a process-lifetime high-water mark, and a second
+  in-process measurement would inherit the first one's peak.
+
+The join basis matters.  The raw RSS watermark is dominated by XLA's
+compile arena (measured here: a TP step's heavier compile swamps the
+~100 MiB the sharding saves), so the gap percentages join the measured
+committed bytes against the liveness report's BOUNDARY decomposition —
+the post-step resident set ``inputs + max(0, outputs - donated)`` per
+shard, scaled by the shard count.  ``peak_live_bytes`` (transients
+included) and the RSS watermark are both recorded per row for the HBM
+headroom question; the ORDERINGS are asserted on the measured committed
+bytes, where they are decided by real replication, not by allocator
+noise.
+
+``bench.py --mem_ledger`` drives one child per program
+(``--mem_ledger_child`` is the child entry), joins the two sides into
+per-program gap percentages (BENCH_r14.json), and asserts the static
+orderings — TP < 1-D, ZeRO < non-ZeRO — hold on the MEASURED numbers,
+not just the predicted ones.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# The update family plus one forward: the programs whose memory behavior
+# the sharding claims are about.  Accum variants share their base
+# program's state layout and double the child fleet for no new ordering
+# information — excluded by default, selectable via --programs.
+DEFAULT_PROGRAMS = (
+    "train_step@dp8",
+    "train_step_zero@dp8",
+    "train_step@tp",
+    "train_step_zero@tp",
+    "serve_forward@dp8",
+)
+
+# (smaller, larger): the static orderings that must hold on measured
+# watermarks.  TP shards params over the model axis (fewer replicated
+# copies in the process); ZeRO shards the optimizer state.
+ORDERINGS: Tuple[Tuple[str, str], ...] = (
+    ("train_step@tp", "train_step@dp8"),          # TP < 1-D
+    ("train_step_zero@dp8", "train_step@dp8"),    # ZeRO < non-ZeRO (1-D)
+    ("train_step_zero@tp", "train_step@tp"),      # ZeRO < non-ZeRO (TP)
+)
+
+
+def predict(model_name: str, mesh_2d: Tuple[int, int],
+            names: Optional[Sequence[str]] = None) -> Dict[str, dict]:
+    """Static predictions per program: the per-shard liveness report plus
+    the whole-process projection (``predicted_total_bytes`` = per-shard
+    peak x shard count).  Abstract tracing only — safe in the parent."""
+    import jax
+
+    from ..analysis.liveness import liveness_of
+    from ..analysis.programs import build_context, build_programs
+    ctx = build_context(model_name, mesh_2d)
+    out: Dict[str, dict] = {}
+    n_shards = int(mesh_2d[0]) * int(mesh_2d[1])
+    for p in build_programs(ctx, list(names) if names else None):
+        closed = jax.make_jaxpr(p.fn)(*p.args)
+        live = liveness_of(closed)
+        # The post-step resident set per shard: non-donated inputs stay
+        # owned by the caller, outputs survive, and donated inputs are
+        # recycled INTO the outputs (an update's new state aliases the
+        # old one's buffers) — so outputs only cost what donation didn't
+        # already pay for.
+        resident = (live["input_bytes"]
+                    + max(0, live["output_bytes"]
+                          - live["donated_input_bytes"]))
+        out[p.name] = {
+            **live,
+            "n_shards": n_shards,
+            "predicted_peak_total_bytes":
+                int(live["peak_live_bytes"]) * n_shards,
+            "predicted_resident_bytes": int(resident) * n_shards,
+        }
+    return out
+
+
+def _concretize(args):
+    """Materialise a program's abstract example args: zeros per
+    ShapeDtypeStruct, a real PRNG key for key-dtype leaves (zeros cannot
+    carry an extended dtype)."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(leaf):
+        try:
+            if jax.dtypes.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+                return jax.random.key(0)
+        except (AttributeError, TypeError):
+            pass
+        return jnp.zeros(leaf.shape, leaf.dtype)
+
+    return jax.tree_util.tree_map(one, args)
+
+
+def _place(p, ctx, args):
+    """Place concrete args the way the trainer would: under a TP plan the
+    state/params must already sit on the plan's shardings — the jitted
+    update aliases donated inputs to sharded outputs, so an unplaced
+    replicated state fails at dispatch (exactly the placement
+    trainer.py does via ``state_shardings`` before training)."""
+    if p.plan is None:
+        return args  # 1-D programs: jit places replicated/auto inputs
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..parallel.tp.plan import state_shardings
+    mesh = ctx.mesh2d
+    sh = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+    if p.kind == "update":
+        state = jax.device_put(
+            args[0], state_shardings(p.plan, mesh, zero=p.zero))
+        return (state,) + tuple(args[1:])
+    if p.kind in ("eval", "forward"):
+        params = jax.device_put(
+            args[0], jax.tree_util.tree_map(sh, p.plan.param_specs))
+        stats = jax.device_put(
+            args[1], jax.tree_util.tree_map(sh, p.plan.stats_specs))
+        return (params, stats) + tuple(args[2:])
+    return args
+
+
+def _ru_maxrss_bytes() -> int:
+    """Process high-water RSS in bytes (Linux reports KiB)."""
+    import resource
+    import sys
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    return int(rss) * (1 if sys.platform == "darwin" else 1024)
+
+
+def live_shard_bytes() -> int:
+    """Exact committed device-buffer bytes in this process right now:
+    every live array's addressable shards summed — a replicated array on
+    R virtual devices costs R full copies, a sharded one costs its
+    slices.  The CPU-backend analogue of ``bytes_in_use``."""
+    import jax
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            if arr.is_deleted():  # donated inputs: buffers recycled
+                continue
+            total += sum(s.data.nbytes for s in arr.addressable_shards)
+        except Exception:
+            continue
+    return total
+
+
+def device_watermark_bytes() -> Optional[int]:
+    """Sum of per-device ``peak_bytes_in_use`` when the backend keeps
+    memory stats (TPU/GPU); None on backends that don't (CPU)."""
+    import jax
+    total, seen = 0, False
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if stats and "peak_bytes_in_use" in stats:
+            total += int(stats["peak_bytes_in_use"])
+            seen = True
+    return total if seen else None
+
+
+def measure_in_process(name: str, model_name: str,
+                       mesh_2d: Tuple[int, int]) -> dict:
+    """Measure ONE program's watermark in THIS process — the child-side
+    body of ``bench.py --mem_ledger_child``.  Baseline is taken after
+    imports + model init (shared fixed cost), so the delta attributes the
+    program's own state materialisation, compile and execution."""
+    import jax
+
+    from ..analysis.programs import build_context, build_programs
+    ctx = build_context(model_name, mesh_2d)
+    progs = build_programs(ctx, [name])
+    if not progs:
+        raise SystemExit(f"program {name!r} not buildable in this "
+                         f"context (no TP plan / no committed auto plan?)")
+    p = progs[0]
+    baseline = _ru_maxrss_bytes()
+    args = _concretize(p.args)
+    args = _place(p, ctx, args)
+    out = p.fn(*args)
+    jax.block_until_ready(out)
+    measured_rss = _ru_maxrss_bytes() - baseline
+    shard_bytes = live_shard_bytes()
+    dev = device_watermark_bytes()
+    return {
+        "program": name,
+        "source": ("device_memory_stats" if dev is not None
+                   else "live_shard_bytes"),
+        # The runtime's own committed device bytes: a true watermark on
+        # backends with memory_stats, the post-step committed floor on
+        # CPU (live per-device shard bytes; `out` and the non-donated
+        # args are still referenced here, so the resident set is whole).
+        "measured_bytes": int(dev if dev is not None else shard_bytes),
+        "live_shard_bytes": int(shard_bytes),
+        "host_watermark_bytes": int(measured_rss),
+        "baseline_rss_bytes": int(baseline),
+        "value": 1,  # sentinel key: bench._run_child picks this line
+    }
+
+
+def join(predicted: Dict[str, dict],
+         measured: Iterable[dict]) -> List[dict]:
+    """Per-program ledger rows: measured committed bytes vs the
+    predicted resident set, gap percentage
+    ((measured - predicted) / predicted x 100)."""
+    rows: List[dict] = []
+    for m in measured:
+        name = m["program"]
+        pred = predicted.get(name)
+        if pred is None:
+            continue
+        basis = pred["predicted_resident_bytes"]
+        gap = ((m["measured_bytes"] - basis) / basis * 100.0) \
+            if basis else None
+        rows.append({
+            "program": name,
+            "predicted_peak_shard_bytes": pred["peak_live_bytes"],
+            "predicted_peak_total_bytes":
+                pred["predicted_peak_total_bytes"],
+            "predicted_resident_bytes": basis,
+            "measured_bytes": m["measured_bytes"],
+            "host_watermark_bytes": m.get("host_watermark_bytes"),
+            "source": m["source"],
+            "gap_pct": None if gap is None else round(gap, 1),
+        })
+    return rows
+
+
+def check_orderings(measured_bytes: Dict[str, int]) -> List[dict]:
+    """Evaluate the static orderings on measured numbers; pairs with a
+    missing side are skipped (e.g. a model without a TP plan)."""
+    out: List[dict] = []
+    for small, large in ORDERINGS:
+        if small not in measured_bytes or large not in measured_bytes:
+            continue
+        out.append({
+            "smaller": small, "larger": large,
+            "smaller_bytes": int(measured_bytes[small]),
+            "larger_bytes": int(measured_bytes[large]),
+            "ok": measured_bytes[small] < measured_bytes[large],
+        })
+    return out
+
+
+def format_ledger(rows: List[dict], orderings: List[dict]) -> str:
+    mib = 2.0 ** 20
+    out = [f"{'program':<24} {'peak total':>11} {'resident':>11} "
+           f"{'measured':>11} {'host peak':>11} {'gap':>8}  source"]
+    for r in rows:
+        gap = ("-" if r["gap_pct"] is None else f"{r['gap_pct']:+.1f}%")
+        host = r.get("host_watermark_bytes")
+        out.append(
+            f"{r['program']:<24} "
+            f"{r['predicted_peak_total_bytes'] / mib:>9.1f}Mi "
+            f"{r['predicted_resident_bytes'] / mib:>9.1f}Mi "
+            f"{r['measured_bytes'] / mib:>9.1f}Mi "
+            + (f"{host / mib:>9.1f}Mi " if host is not None
+               else f"{'-':>11} ")
+            + f"{gap:>8}  {r['source']}")
+    for o in orderings:
+        verdict = "ok" if o["ok"] else "VIOLATED"
+        out.append(
+            f"ordering {o['smaller']} < {o['larger']}: "
+            f"{o['smaller_bytes'] / mib:.1f}Mi < "
+            f"{o['larger_bytes'] / mib:.1f}Mi  [{verdict}]")
+    return "\n".join(out)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m ddp_tpu.obs.memledger --predict`` — the abstract side
+    only (no devices needed); the measured join lives in ``bench.py
+    --mem_ledger`` where the child-process harness is."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="ddp_tpu.obs.memledger")
+    ap.add_argument("--model", default="deepnn")
+    ap.add_argument("--mesh", default="2,4")
+    ap.add_argument("--programs", nargs="*", default=None)
+    args = ap.parse_args(argv)
+    d, m = (int(x) for x in args.mesh.split(","))
+    pred = predict(args.model, (d, m), args.programs)
+    print(json.dumps(pred, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

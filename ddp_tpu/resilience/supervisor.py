@@ -197,6 +197,49 @@ class FailureLedger:
         self.deaths: List[dict] = []
         self._offset = 0
         self._sig_counts: dict = {}
+        # Flight-recorder linkage: the child dumps postmortem.json next
+        # to the metrics JSONL on every abnormal exit (obs/blackbox.py).
+        # Remember the bundle's identity at construction so a stale file
+        # left by a PREVIOUS run is never attributed to this run's first
+        # death — only a bundle that changed since last look counts.
+        self._pm_seen = self._postmortem_stat()
+
+    def _postmortem_path(self) -> Optional[str]:
+        if not self.metrics_path:
+            return None
+        from ..obs.blackbox import POSTMORTEM_BASENAME  # stdlib-only
+        return os.path.join(
+            os.path.dirname(os.path.abspath(self.metrics_path)),
+            POSTMORTEM_BASENAME)
+
+    def _postmortem_stat(self) -> Optional[Tuple[float, int]]:
+        path = self._postmortem_path()
+        if not path:
+            return None
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime, st.st_size)
+
+    def _link_postmortem(self) -> Optional[dict]:
+        """The just-dead child's flight-recorder bundle, schema-checked —
+        or ``None`` when there is no FRESH bundle (a SIGKILLed child
+        cannot dump; an unchanged file belongs to an earlier death)."""
+        stat = self._postmortem_stat()
+        if stat is None or stat == self._pm_seen:
+            return None
+        self._pm_seen = stat
+        path = self._postmortem_path()
+        from ..obs.blackbox import validate_postmortem  # stdlib-only
+        try:
+            with open(path) as f:  # type: ignore[arg-type]
+                doc = json.load(f)
+            validate_postmortem(doc)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            return {"path": path, "valid": False, "error": str(e)}
+        return {"path": path, "valid": True, "reason": doc["reason"],
+                "exit_status": doc["exit_status"]}
 
     def record_death(self, *, exit_code: int, reason: str,
                      mesh: Optional[str], wall_s: float) -> dict:
@@ -217,6 +260,7 @@ class FailureLedger:
             "last_event": events[-1] if events else None,
             "signature": sig,
             "signature_count": count,
+            "postmortem": self._link_postmortem(),
         }
         self.deaths.append(entry)
         return entry
@@ -248,11 +292,16 @@ class FailureLedger:
             if d["signature"] is not None:
                 sig_txt = (f"{d['signature'][0]}@step={d['signature'][1]} "
                            f"(x{d['signature_count']})")
+            pm = d.get("postmortem")
+            pm_txt = "-"
+            if pm is not None:
+                pm_txt = (pm["reason"] if pm.get("valid")
+                          else f"INVALID({pm.get('error', '?')})")
             lines.append(
                 f"  death {d['death']}: exit {d['exit_code']} "
                 f"({d['reason']}) mesh={d['mesh'] or '-'} "
                 f"wall={d['wall_s']:.1f}s last_event={last_txt} "
-                f"signature={sig_txt}")
+                f"signature={sig_txt} postmortem={pm_txt}")
         return "\n".join(lines)
 
 
@@ -429,6 +478,10 @@ class Supervisor:
             "mesh": entry.get("mesh"),
             "checkpoint": ckpt,
             "mirror": _get_flag(self.child_argv, "--mirror"),
+            # The dying attempt's flight-recorder bundle (fresh-file
+            # check in FailureLedger._link_postmortem): the autopsy for
+            # `python -m ddp_tpu.obs --postmortem <path>`.
+            "postmortem": entry.get("postmortem"),
             "last_events": [d.get("last_event")
                             for d in self.ledger.deaths],
             "deaths": self.ledger.deaths,

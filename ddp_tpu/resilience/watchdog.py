@@ -81,6 +81,12 @@ class Watchdog:
         self._last = time.monotonic()
         self.beats += 1
 
+    def last_beat_age(self) -> float:
+        """Seconds since the last heartbeat — the /healthz liveness
+        number (obs/inspect.py): an age approaching ``timeout_s`` is a
+        stall in progress, visible before the expiry fires."""
+        return time.monotonic() - self._last
+
     def start(self) -> "Watchdog":
         if self._thread is not None:
             return self

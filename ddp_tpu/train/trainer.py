@@ -103,7 +103,8 @@ class Trainer:
                  guard_spike_factor: float = 0.0,
                  guard_action: str = "rollback",
                  registry=None,
-                 mirror=None):
+                 mirror=None,
+                 step_probe=None):
         self.model = model
         self.train_loader = train_loader
         self.mesh = mesh
@@ -272,6 +273,16 @@ class Trainer:
         # rolling live-stats engine (rank 0, obs/live.py).
         self.tracer = tracer if tracer is not None else get_tracer()
         self._live = live if self.gpu_id == 0 else None
+        # Introspection probe (obs/inspect.py): one bounded callable per
+        # optimizer step — the periodic .prom rewrite and the on-demand
+        # profile trigger both hang off it.  Rank 0 only (the rank that
+        # owns the registry and the inspect server); the callable itself
+        # must never raise into the step loop — both probes swallow and
+        # self-disable on error.
+        self._step_probe = step_probe if self.gpu_id == 0 else None
+        # Host-side epoch mirror for the /healthz snapshot (reading the
+        # loop variable from another thread needs a stable home).
+        self._host_epoch = self.start_epoch
         # Mirror uploader (rank 0 — the rank that commits lineage): one
         # background thread, fed after each commit, strictly off the
         # critical path.  Lineage manifests stamp each entry's mirror
@@ -472,6 +483,8 @@ class Trainer:
                                       guard=self._health)
             if self._watchdog is not None:
                 self._watchdog.beat()
+            if self._step_probe is not None:
+                self._step_probe(step)
         return jnp.stack(epoch_losses) if epoch_losses else None
 
     def _epoch_losses_resident(self):
@@ -535,12 +548,18 @@ class Trainer:
         # loss's step back to its data position (guard rollback's skip
         # window, mid-epoch data_state).
         self._epoch_origin[epoch] = (self._host_step, start_offset)
+        self._host_epoch = epoch
         self.train_loader.set_epoch(epoch)
         stacked = (self._epoch_losses_resident() if self.resident is not None
                    else self._epoch_losses_streaming(epoch, start_offset))
         n_losses = int(stacked.shape[0]) if stacked is not None else 0
         start_step = self._host_step
         self._host_step += n_losses
+        if self._step_probe is not None and self.resident is not None:
+            # Resident mode dispatches whole epochs — the probe fires at
+            # the coarsest boundary that exists (per-step capture needs
+            # the streaming loop).
+            self._step_probe(self._host_step)
         # Defer the host read: flush the PREVIOUS epoch's losses now that
         # this epoch's work is queued behind them — the D2H transfer and
         # the next epoch's host prep then overlap device compute.  This

@@ -42,6 +42,11 @@ class MetricsLogger:
         self.path = path
         self._f: Optional[IO[str]] = None
         self._tb = None
+        # Optional flight-recorder tap (obs/blackbox.py): every record
+        # that reaches _emit is also appended to the recorder's bounded
+        # ring, so a postmortem bundle carries the same event stream the
+        # JSONL holds — without re-reading the file at crash time.
+        self._recorder = None
         # Monotonic basis: wall_s must survive NTP slews / clock jumps
         # (it is the timeline every cross-record attribution joins on).
         self._t0 = time.monotonic()
@@ -65,15 +70,22 @@ class MetricsLogger:
         callers skip building telemetry no sink would receive."""
         return self._f is not None or self._tb is not None
 
+    def attach_recorder(self, recorder) -> None:
+        """Tee every emitted record into a flight recorder's ring
+        (``recorder.record(rec)``) — cli.py attaches it right after
+        constructing the :class:`obs.blackbox.FlightRecorder`."""
+        self._recorder = recorder
+
     def _emit(self, rec: dict, scalars: Optional[dict] = None,
               step: Optional[int] = None) -> None:
         """THE sink: JSONL line (with the shared wall_s clock) plus the
         optional TensorBoard scalar mirror.  Every public log_* method
         lands here — one place for format, clock, and buffering policy."""
+        stamped = {**rec, "wall_s": round(time.monotonic() - self._t0, 3)}
         if self._f is not None:
-            self._f.write(json.dumps({
-                **rec, "wall_s": round(time.monotonic() - self._t0, 3),
-            }) + "\n")
+            self._f.write(json.dumps(stamped) + "\n")
+        if self._recorder is not None:
+            self._recorder.record(stamped)
         if self._tb is not None and scalars:
             with self._tb.as_default():
                 for tag, val in scalars.items():

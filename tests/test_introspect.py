@@ -1,0 +1,381 @@
+"""Live introspection plane (obs/inspect.py + obs/blackbox.py): mid-run
+HTTP scrapes under the strict exposition parser, flight-recorder dumps
+on the abnormal exit paths, the SIGUSR1 profile round-trip, the
+crash-atomic .prom rewrite, and the zero-sockets/zero-artifacts contract
+of ``--obs_off`` + no ``--inspect_port``."""
+import json
+import os
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ddp_tpu.obs.blackbox import (FlightRecorder, atomic_write_text,
+                                  format_postmortem, validate_postmortem)
+from ddp_tpu.obs.inspect import (InspectServer, ProfileTrigger,
+                                 PromFileWriter, install_sigusr1)
+from ddp_tpu.obs.registry import MetricsRegistry, parse_exposition
+from ddp_tpu.obs.tracer import SpanTracer
+
+# Same short CLI config as test_obs's e2e block: 2 epochs, 4 steps each.
+_ARGV = ["2", "1", "--batch_size", "8", "--synthetic", "--model",
+         "deepnn", "--lr", "0.02", "--num_devices", "2",
+         "--synthetic_size", "64", "--metrics_path", "m.jsonl",
+         "--log_every", "2"]
+
+
+def _get(port: int, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+# ---------------------------------------------------------------------------
+# mid-run endpoints against a REAL training run
+
+
+def test_inspect_endpoints_mid_run(tmp_path, capsys, monkeypatch):
+    """--inspect_port 0 (ephemeral) on a real run: /metrics strict-parses
+    MID-RUN, /healthz carries live trainer state, /spans returns the
+    ring, /debug/profile arms, unknown paths 404 — and the periodic
+    .prom file exists (and parses) before the run ends."""
+    from ddp_tpu import cli
+    from ddp_tpu.obs import inspect as inspect_mod
+
+    captured: list = []
+
+    class _Capture(InspectServer):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            captured.append(self)
+
+    monkeypatch.setattr(inspect_mod, "InspectServer", _Capture)
+    monkeypatch.chdir(tmp_path)
+
+    scrapes: dict = {}
+
+    def _scraper():
+        deadline = time.monotonic() + 120.0
+        while not captured and time.monotonic() < deadline:
+            time.sleep(0.01)
+        if not captured:
+            scrapes["error"] = "server never constructed"
+            return
+        port = captured[0].port
+        try:
+            # Wait for the run to be genuinely mid-flight: at least one
+            # optimizer step completed per /healthz.
+            while time.monotonic() < deadline:
+                _, _, body = _get(port, "/healthz")
+                health = json.loads(body)
+                if health.get("step", 0) >= 1:
+                    break
+                time.sleep(0.01)
+            scrapes["healthz"] = health
+            scrapes["metrics"] = _get(port, "/metrics")
+            scrapes["spans"] = json.loads(
+                _get(port, "/spans")[2])["spans"]
+            scrapes["profile"] = _get(port, "/debug/profile?steps=2")
+            scrapes["prom_mid_run"] = (
+                open("m.jsonl.prom").read()
+                if os.path.exists("m.jsonl.prom") else None)
+            try:
+                _get(port, "/nope")
+            except urllib.error.HTTPError as e:
+                scrapes["404"] = (e.code, e.read())
+        except Exception as e:  # noqa: BLE001 — surfaced via assert below
+            scrapes["error"] = repr(e)
+
+    t = threading.Thread(target=_scraper, daemon=True)
+    t.start()
+    # A (generous) watchdog so /healthz carries the liveness age and the
+    # watchdog counter families are registered.
+    args = cli.build_parser("t").parse_args(
+        _ARGV + ["--inspect_port", "0", "--watchdog_secs", "300"])
+    cli.run(args, num_devices=None)
+    t.join(timeout=30)
+    capsys.readouterr()
+    assert "error" not in scrapes, scrapes
+    assert not t.is_alive()
+
+    # /metrics: exposition content type + STRICT parse, live values.
+    status, ctype, body = scrapes["metrics"]
+    assert status == 200 and ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    fams = parse_exposition(body.decode())
+    assert "ddp_watchdog_beats_total" in fams
+    assert "ddp_guard_decisions_total" in fams
+    # /healthz: the one shared run-state snapshot, mid-flight.
+    health = scrapes["healthz"]
+    assert health["step"] >= 1
+    assert "watchdog_last_beat_age_s" in health
+    assert "guard_last_decision" in health
+    # /spans: the tracer ring as JSON records.
+    assert any(s["phase"] == "dispatch" for s in scrapes["spans"])
+    # /debug/profile: armed (CPU backend => spans-only capture).
+    status, _, body = scrapes["profile"]
+    assert status == 200 and json.loads(body)["armed"] is True
+    # Periodic .prom rewrite: present and parseable MID-RUN.
+    assert scrapes["prom_mid_run"], "no .prom file existed mid-run"
+    assert "ddp_guard_decisions_total" in parse_exposition(
+        scrapes["prom_mid_run"])
+    # 404 names the routes.
+    code, body404 = scrapes["404"]
+    assert code == 404 and b"/healthz" in body404
+    # The armed capture landed by end of run (spans-only on CPU).
+    caps = [f for f in os.listdir(tmp_path)
+            if f.startswith("profile_capture_step")]
+    assert caps, "armed profile trigger never wrote its capture"
+    doc = json.load(open(caps[0]))
+    assert doc["schema"] == "profile_capture/1"
+    assert doc["spans"] and doc["trace_dir"] is None  # CPU: spans only
+    # Clean exit: NO postmortem bundle.
+    assert not os.path.exists("postmortem.json")
+
+
+def test_obs_off_and_no_port_bind_nothing(tmp_path, capsys, monkeypatch):
+    """The zero-overhead contract: without --inspect_port no socket is
+    ever bound (InspectServer not even constructed), and --obs_off also
+    suppresses the profile trigger and flight recorder — a clean run
+    leaves no postmortem, no capture files."""
+    from ddp_tpu import cli
+    from ddp_tpu.obs import inspect as inspect_mod
+
+    def _boom(*a, **kw):
+        raise AssertionError("InspectServer constructed without "
+                             "--inspect_port")
+
+    monkeypatch.setattr(inspect_mod, "InspectServer", _boom)
+    monkeypatch.chdir(tmp_path)
+    args = cli.build_parser("t").parse_args(_ARGV + ["--obs_off"])
+    cli.run(args, num_devices=None)
+    capsys.readouterr()
+    assert not os.path.exists("postmortem.json")
+    assert not [f for f in os.listdir(tmp_path)
+                if f.startswith(("profile_capture", "profile_trace"))]
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder dumps on the abnormal exit paths
+
+
+def test_drift_abort_dumps_postmortem(tmp_path, capsys, monkeypatch):
+    """An injected flip_param_bit SDC under --drift_action abort: the
+    run dies with DriftDetectedError AND leaves a schema-valid bundle
+    whose reason is drift_abort; the renderer accepts it."""
+    from ddp_tpu import cli
+    from ddp_tpu.resilience.drift import DriftDetectedError
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("DDP_TPU_FAULT", "flip_param_bit@step=2,replica=1")
+    # No --mesh_shape: the drift audit refuses tensor-parallel plans
+    # (same reason chaos_campaign's flip drill runs config C).
+    args = cli.build_parser("t").parse_args(
+        _ARGV + ["--drift_audit_every", "1", "--drift_action", "abort"])
+    with pytest.raises(DriftDetectedError):
+        cli.run(args, num_devices=None)
+    capsys.readouterr()
+    doc = json.load(open("postmortem.json"))
+    validate_postmortem(doc)
+    assert doc["reason"] == "drift_abort" and doc["exit_status"] == 1
+    assert "DriftDetectedError" in doc["error"]
+    assert doc["config"]["model"] == "deepnn"
+    # The metrics tap fed the ring: the drift event is on the timeline.
+    assert any(e.get("event") == "drift_detected" for e in doc["events"])
+    out = format_postmortem(doc)
+    assert "drift_abort" in out and "drift_detected" in out
+
+
+def test_watchdog_expiry_dumps_postmortem_bounded(tmp_path):
+    """The on_expire composition: a stalled 'run' expires the watchdog,
+    which lands a schema-valid watchdog_stall bundle through the BOUNDED
+    dump path (side thread + join) before the (patched) hard exit."""
+    from ddp_tpu.resilience.watchdog import WATCHDOG_EXIT_STATUS, Watchdog
+
+    tracer = SpanTracer()
+    with tracer.span("dispatch", step=3):
+        pass
+    path = str(tmp_path / "postmortem.json")
+    recorder = FlightRecorder(path, config={"model": "t"}, tracer=tracer,
+                              context=lambda: {"step": 3})
+    fired: list = []
+
+    def _on_expire():
+        recorder.dump("watchdog_stall", exit_status=WATCHDOG_EXIT_STATUS,
+                      error="watchdog: no heartbeat", bounded=True)
+
+    wd = Watchdog(0.2, on_expire=_on_expire)
+    wd._exit = fired.append  # seam: don't kill pytest
+    wd.start()
+    deadline = time.monotonic() + 10.0
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.02)
+    wd.stop()
+    assert fired == [WATCHDOG_EXIT_STATUS]
+    doc = json.load(open(path))
+    validate_postmortem(doc)
+    assert doc["reason"] == "watchdog_stall"
+    assert doc["exit_status"] == WATCHDOG_EXIT_STATUS
+    assert any(s["phase"] == "dispatch" for s in doc["spans"])
+    assert recorder.dumped == "watchdog_stall"
+
+
+# ---------------------------------------------------------------------------
+# SIGUSR1 profile round-trip (headless boxes have no HTTP client handy)
+
+
+def test_sigusr1_profile_round_trip(tmp_path):
+    tracer = SpanTracer()
+    trigger = ProfileTrigger(tracer, str(tmp_path),
+                             profiler_available=False)
+    # Park a benign handler underneath so the post-uninstall signal hits
+    # it instead of the default action (which would terminate pytest).
+    dummy_hits: list = []
+    outer = signal.signal(signal.SIGUSR1,
+                          lambda signum, frame: dummy_hits.append(1))
+    try:
+        uninstall = install_sigusr1(trigger, steps=2)
+        assert uninstall is not None  # pytest tests run on the main thread
+        os.kill(os.getpid(), signal.SIGUSR1)
+        # The handler runs between bytecodes; give it a delivery point.
+        deadline = time.monotonic() + 5.0
+        while not trigger.armed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert trigger.armed
+        for step in range(5, 10):
+            with tracer.span("dispatch", step=step):
+                pass
+            trigger.step(step)
+        uninstall()
+        # Uninstalled: the signal reaches the prior handler, not request().
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.monotonic() + 5.0
+        while not dummy_hits and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert dummy_hits and not trigger.armed
+    finally:
+        signal.signal(signal.SIGUSR1, outer)
+    assert len(trigger.captures) == 1
+    doc = json.load(open(trigger.captures[0]))
+    assert doc["schema"] == "profile_capture/1"
+    assert doc["start_step"] == 5 and doc["end_step"] == 7
+    # t0 is stamped at arming step 5, so the window holds steps 6-7.
+    assert [s["step"] for s in doc["spans"]] == [6, 7]
+
+
+# ---------------------------------------------------------------------------
+# crash-atomic .prom rewrites: a scraper never sees a torn file
+
+
+def test_prom_rewrite_never_torn(tmp_path):
+    """Reader/writer race on the periodic .prom rewrite: every read of
+    the file strict-parses — os.replace means the previous complete
+    exposition or the new one, never a prefix."""
+    registry = MetricsRegistry()
+    n = registry.counter("ddp_test_total", "padded out so the exposition "
+                         "spans several write() calls")
+    n.inc()  # materialize the sample before the first read
+    path = str(tmp_path / "m.prom")
+    writer = PromFileWriter(registry, path, every=1)
+    writer.write()
+    stop = threading.Event()
+    torn: list = []
+
+    def _reader():
+        while not stop.is_set():
+            try:
+                text = open(path).read()
+            except FileNotFoundError:
+                continue
+            try:
+                fams = parse_exposition(text)
+                assert "ddp_test_total" in fams
+            except Exception as e:  # noqa: BLE001
+                torn.append((repr(e), text[-80:]))
+                return
+
+    threads = [threading.Thread(target=_reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for step in range(1, 400):
+        n.inc()
+        writer.step(step)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not torn, torn[:1]
+    # The final content reflects the last write cadence boundary.
+    fams = parse_exposition(open(path).read())
+    assert fams["ddp_test_total"]["samples"][("ddp_test_total", ())] >= 1.0
+
+
+def test_prom_writer_cadence_and_dead_path(tmp_path, capsys):
+    """step() rewrites once per `every` boundary; an unwritable path
+    warns ONCE and goes dead instead of spamming the step loop."""
+    registry = MetricsRegistry()
+    registry.counter("ddp_x_total", "")
+    path = str(tmp_path / "cadence.prom")
+    writer = PromFileWriter(registry, path, every=10)
+    writer.step(3)  # the very first step always writes (early visibility)
+    assert os.path.exists(path)
+    mtime = os.path.getmtime(path)
+    writer.step(5)  # same cadence window: no rewrite
+    assert os.path.getmtime(path) == mtime
+    writer.step(12)  # crossed the boundary: rewrite
+    assert writer._last_written == 12
+
+    bad = PromFileWriter(registry, str(tmp_path / "no_dir" / "x.prom"),
+                         every=1)
+    bad.step(1)
+    bad.step(2)
+    err = capsys.readouterr().err
+    assert err.count("WARNING") == 1  # once, then dead
+
+
+# ---------------------------------------------------------------------------
+# the bundle renderer CLI (python -m ddp_tpu.obs --postmortem)
+
+
+def test_obs_cli_postmortem_mode(tmp_path, capsys):
+    from ddp_tpu.obs.__main__ import main as obs_main
+
+    tracer = SpanTracer()
+    path = str(tmp_path / "postmortem.json")
+    rec = FlightRecorder(path, config={"model": "t", "total_epochs": 1},
+                         tracer=tracer, context=lambda: {"step": 9})
+    rec.record({"event": "guard_decision", "decision": "spike_abort",
+                "step": 9, "wall_s": 1.0})
+    rec.dump("guard_abort", exit_status=1, error="LossSpikeError('9')")
+    assert obs_main(["--postmortem", path]) == 0
+    out = capsys.readouterr().out
+    assert "guard_abort" in out and "spike_abort" in out
+
+    # Missing / torn / invalid: exit 2 with a one-line diagnosis.
+    assert obs_main(["--postmortem", str(tmp_path / "gone.json")]) == 2
+    (tmp_path / "torn.json").write_text('{"schema": "postmor')
+    assert obs_main(["--postmortem", str(tmp_path / "torn.json")]) == 2
+    (tmp_path / "bad.json").write_text(json.dumps({"schema": "nope/9"}))
+    assert obs_main(["--postmortem", str(tmp_path / "bad.json")]) == 2
+    err = capsys.readouterr().err
+    assert "torn postmortem bundle" in err
+    assert "invalid postmortem bundle" in err
+
+
+# ---------------------------------------------------------------------------
+# atomic_write_text failure hygiene
+
+
+def test_atomic_write_cleans_tmp_on_failure(tmp_path, monkeypatch):
+    target = str(tmp_path / "out.json")
+
+    def _fail_replace(src, dst):
+        raise OSError("disk says no")
+
+    monkeypatch.setattr(os, "replace", _fail_replace)
+    with pytest.raises(OSError):
+        atomic_write_text(target, "{}")
+    monkeypatch.undo()
+    assert os.listdir(tmp_path) == []  # no orphaned .tmp sibling

@@ -271,6 +271,43 @@ def test_ledger_reads_only_new_events_per_death(tmp_path):
     assert not FailureLedger.is_deterministic(e2)
 
 
+def test_ledger_links_only_fresh_postmortem_bundles(tmp_path):
+    """Flight-recorder linkage: a bundle next to the metrics JSONL is
+    attributed to a death only when it CHANGED since the ledger last
+    looked — a stale file from an earlier run (or a SIGKILLed child that
+    never dumped) must not be claimed; a torn one is flagged invalid."""
+    mpath = str(tmp_path / "m.jsonl")
+    pm = tmp_path / "postmortem.json"
+    # Stale bundle exists BEFORE the ledger is built: never attributed.
+    pm.write_text(json.dumps({"schema": "postmortem/1", "reason": "crash"}))
+    led = FailureLedger(mpath)
+    e1 = led.record_death(exit_code=1, reason="crash", mesh=None,
+                          wall_s=1.0)
+    assert e1["postmortem"] is None
+    # A fresh, valid bundle lands between looks: linked with its reason.
+    doc = {"schema": "postmortem/1", "reason": "watchdog_stall",
+           "exit_status": 124, "error": "watchdog", "time_unix": 1.0,
+           "uptime_s": 2.0, "config": {}, "health": {}, "spans": [],
+           "events": []}
+    pm.write_text(json.dumps(doc))
+    e2 = led.record_death(exit_code=124, reason="stalled", mesh=None,
+                          wall_s=1.0)
+    assert e2["postmortem"]["valid"] is True
+    assert e2["postmortem"]["reason"] == "watchdog_stall"
+    assert e2["postmortem"]["exit_status"] == 124
+    # Unchanged since: the next death must not re-claim the same bundle.
+    e3 = led.record_death(exit_code=1, reason="crash", mesh=None,
+                          wall_s=1.0)
+    assert e3["postmortem"] is None
+    # A fresh but torn/invalid bundle is linked AND flagged.
+    pm.write_text('{"schema": "postmortem/1", "reaso')
+    e4 = led.record_death(exit_code=1, reason="crash", mesh=None,
+                          wall_s=1.0)
+    assert e4["postmortem"]["valid"] is False
+    assert "postmortem" in led.format()
+    assert "watchdog_stall" in led.format()
+
+
 def test_supervise_main_requires_child_command(capsys):
     assert supervise_main([]) == 2
     assert "usage" in capsys.readouterr().err
@@ -439,8 +476,64 @@ def test_bench_trend_ignores_chaos_files(tmp_path, monkeypatch, capsys):
     monkeypatch.chdir(tmp_path)
     assert bench_trend.main(["--glob", "*_r*.json"]) == 0
     out = capsys.readouterr()
-    assert "ignoring 1 CHAOS_* scorecard(s)" in out.err
+    assert "ignoring 1 non-bench artifact(s)" in out.err
     assert "chaos" not in out.out.lower()  # no bogus metric family
+
+
+def test_bench_trend_ignores_postmortem_and_profile_artifacts(
+        tmp_path, monkeypatch, capsys):
+    """Introspection artifacts (postmortem bundles, profile captures,
+    diagnosis.json) are JSON files that land next to bench records; a
+    sloppy '*.json' glob must not turn them into metric families."""
+    bench_trend = _load_tool("bench_trend")
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"metric": "train throughput (cpu)",
+                    "value": 100.0, "unit": "samples/sec"}}))
+    (tmp_path / "postmortem.json").write_text(json.dumps(
+        {"schema": "postmortem/1", "reason": "crash"}))
+    (tmp_path / "profile_capture_step3.json").write_text(json.dumps(
+        {"schema": "profile_capture/1", "start_step": 3}))
+    (tmp_path / "diagnosis.json").write_text(json.dumps(
+        {"schema": "diagnosis/1"}))
+    monkeypatch.chdir(tmp_path)
+    assert bench_trend.main(["--glob", "*.json"]) == 0
+    out = capsys.readouterr()
+    assert "ignoring 3 non-bench artifact(s)" in out.err
+    assert "postmortem" not in out.out
+    assert len([ln for ln in out.out.splitlines() if "throughput" in ln]) == 1
+
+
+def test_bench_trend_mem_gap_family(tmp_path, monkeypatch, capsys):
+    """A --mem_ledger record's mem_gap_pct dict expands into one
+    lower-better family per program (absolute gap), alongside the
+    median-abs-gap headline — and a growing |gap| WARNs."""
+    bench_trend = _load_tool("bench_trend")
+    common = {"unit": "% median absolute measured-vs-predicted "
+                      "resident-bytes gap across programs"}
+    (tmp_path / "BENCH_r14.json").write_text(json.dumps({"parsed": {
+        "metric": "deepnn measured-vs-predicted per-program device "
+                  "memory (cpu mesh 4x2)",
+        "value": 8.0, **common,
+        "mem_gap_pct": {"train_step@dp8": 6.2, "train_step@tp": -8.0}}}))
+    (tmp_path / "BENCH_r15.json").write_text(json.dumps({"parsed": {
+        "metric": "deepnn measured-vs-predicted per-program device "
+                  "memory (cpu mesh 2x4)",
+        "value": 9.0, **common,
+        "mem_gap_pct": {"train_step@dp8": 6.0, "train_step@tp": -30.0}}}))
+    monkeypatch.chdir(tmp_path)
+    rc = bench_trend.main(["--glob", "BENCH_*.json", "--threshold", "10",
+                           "--strict"])
+    out = capsys.readouterr()
+    # Per-program families exist and carry |gap| (sign stripped).
+    assert "memory gap train_step@dp8" in out.out
+    assert "memory gap train_step@tp" in out.out
+    # tp's |gap| grew 8 -> 30 (+275% vs best) => lower-better WARN;
+    # dp8 shrank 6.2 -> 6.0 => ok.  --strict surfaces it as exit 1.
+    assert rc == 1
+    assert any("memory gap train_step@tp" in w
+               for w in out.out.splitlines() if w.startswith("WARN:"))
+    assert not any("train_step@dp8" in w
+                   for w in out.out.splitlines() if w.startswith("WARN:"))
 
 
 # -- chaos campaign plumbing (no training subprocesses) --------------------

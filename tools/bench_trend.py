@@ -95,6 +95,23 @@ def _records_of(doc: dict) -> List[dict]:
         if r.get("metric") and k not in seen:
             seen.add(k)
             uniq.append(r)
+    # Memory-ledger records (bench.py --mem_ledger, r14+) carry a
+    # per-program gap dict; expand it into one family per program so the
+    # trend tracks each program's measured-vs-predicted gap separately —
+    # the headline (median abs gap) hides a single program drifting.
+    # Absolute value: the trajectory cares about |gap| shrinking, and a
+    # sign flip through zero is not an improvement past the prediction.
+    for r in list(uniq):
+        gaps = r.get("mem_gap_pct")
+        if not isinstance(gaps, dict):
+            continue
+        for prog, gap in sorted(gaps.items()):
+            if isinstance(gap, (int, float)):
+                uniq.append({
+                    "metric": f"memory gap {prog}",
+                    "value": abs(gap),
+                    "unit": "% absolute measured-vs-predicted "
+                            "resident-bytes gap"})
     return uniq
 
 
@@ -198,13 +215,18 @@ def main(argv: Optional[list] = None) -> int:
     # records and match sloppy globs like '*_r*.json', but they hold
     # pass/fail drill verdicts, not metric trajectories — mixing them in
     # would invent bogus families.
-    chaos = [p for p in paths
-             if os.path.basename(p).startswith("CHAOS_")]
-    if chaos:
-        print(f"ignoring {len(chaos)} CHAOS_* scorecard(s): "
-              + ", ".join(os.path.basename(p) for p in chaos),
+    # Introspection artifacts (obs/blackbox.py postmortem bundles,
+    # obs/inspect.py profile captures, supervisor diagnosis.json) are
+    # also JSON and also land in run directories sloppy globs cover.
+    _ARTIFACT_PREFIXES = ("CHAOS_", "postmortem", "profile_capture",
+                          "profile_trace", "diagnosis")
+    skipped = [p for p in paths
+               if os.path.basename(p).startswith(_ARTIFACT_PREFIXES)]
+    if skipped:
+        print(f"ignoring {len(skipped)} non-bench artifact(s): "
+              + ", ".join(os.path.basename(p) for p in skipped),
               file=sys.stderr)
-        paths = [p for p in paths if p not in chaos]
+        paths = [p for p in paths if p not in skipped]
     if not paths:
         print(f"no files match {args.glob!r} — nothing to compare",
               file=sys.stderr)

@@ -7,10 +7,12 @@ and no operator input?
 Per drill the scorecard (``CHAOS_r01.json``-style, ``--out``) records:
 restarts the supervisor spent (by classified reason, read back from the
 supervisor's own ``.prom`` exposition), death-to-relaunch recovery time
-(the supervisor's recovery histogram sum), wall time, and final-state
+(the supervisor's recovery histogram sum), wall time, final-state
 BIT-PARITY against an undisturbed control run of the same config — the
 resumed trajectory must land on the identical bytes, anything else is
-silent data loss.
+silent data loss — and the flight-recorder bundle: every abnormal exit
+must leave a schema-valid ``postmortem.json`` (obs/blackbox.py) in the
+drill's workdir, or the drill FAILs even if the data survived.
 
 The matrix (one entry per injected failure mode the resilience layer
 claims to survive):
@@ -191,6 +193,28 @@ def _supervisor_stats(workdir: str) -> Dict[str, object]:
     return out
 
 
+def _postmortem_check(workdir: str) -> dict:
+    """Every abnormal exit must leave a schema-valid flight-recorder
+    bundle next to the metrics JSONL (obs/blackbox.py) — the drill's
+    autopsy.  Scored per drill: a campaign that survives the fault but
+    loses the postmortem has lost the artifact trail the supervisor's
+    ledger and diagnosis.json link into."""
+    from ddp_tpu.obs.blackbox import validate_postmortem
+    path = os.path.join(workdir, "postmortem.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        return {"present": os.path.exists(path), "valid": False,
+                "error": str(e)}
+    try:
+        validate_postmortem(doc)
+    except ValueError as e:
+        return {"present": True, "valid": False, "error": str(e)}
+    return {"present": True, "valid": True, "reason": doc["reason"],
+            "exit_status": doc["exit_status"]}
+
+
 def _run_control(config: str, root: str, env: dict,
                  timeout: float) -> dict:
     workdir = os.path.join(root, f"control_{config}")
@@ -297,14 +321,19 @@ def run_campaign(drills: List[str], root: str, env: dict,
                                      "ck.npz")))
         res["bit_identical"] = bit
         res["zero_data_loss"] = bit and res["supervisor_exit"] == 0
-        res["pass"] = res["zero_data_loss"]
+        # Every drill kills the child abnormally at least once, so a
+        # schema-valid postmortem.json must be in the workdir (the last
+        # death's bundle survives the successful relaunch untouched).
+        res["postmortem"] = _postmortem_check(res["workdir"])
+        res["pass"] = res["zero_data_loss"] and res["postmortem"]["valid"]
         res.pop("workdir")
         results[name] = res
         print(f"[chaos] {name}: exit={res['supervisor_exit']} "
               f"restarts={res['restarts']} {res['restart_reasons']} "
               f"recover={res['recovery_seconds_sum']}s "
-              f"bit_identical={bit} -> "
-              f"{'PASS' if res['pass'] else 'FAIL'}", flush=True)
+              f"bit_identical={bit} "
+              f"postmortem={res['postmortem'].get('reason', 'MISSING')}"
+              f" -> {'PASS' if res['pass'] else 'FAIL'}", flush=True)
     for c in controls.values():
         c.pop("workdir")
     ok = all(r["pass"] for r in results.values())
