@@ -107,11 +107,14 @@ def _parse_args():
                         "every window lands in window_ms_per_step with "
                         "best/spread fields, so a noisy link is visible "
                         "in the record itself")
-    p.add_argument("--mesh_shape", default=None, metavar="D,M",
-                   help="2-D (data x model) tensor-parallel mesh for the "
-                        "steady-state step bench (parallel/tp/): "
-                        "--batch_size is per DATA shard; the plan comes "
-                        "from the model's TP_RECIPE")
+    p.add_argument("--mesh_shape", default=None, metavar="D,M[,S]",
+                   help="(data x model[ x stage]) mesh for the "
+                        "steady-state step bench (parallel/tp/, "
+                        "parallel/pp/): --batch_size is per DATA shard; "
+                        "the tp plan comes from the model's TP_RECIPE; a "
+                        "third entry S>1 times the pipelined step "
+                        "(--pp_micro micro-batches, 1F1B) and records "
+                        "the measured-vs-predicted bubble fraction")
     p.add_argument("--tp_sweep", default=None, metavar="M1,M2,...",
                    help="Tensor-parallel sweep: one child per model-axis "
                         "size M over the same device total (data axis = "
@@ -119,6 +122,22 @@ def _parse_args():
                         "records ms/step + MFU per mesh shape (the "
                         "model-axis cost curve; chip paste in RUNBOOK "
                         "section 10).  Uses --sweep_platform like --sweep")
+    p.add_argument("--pp_sweep", default=None, metavar="S1,S2,...",
+                   help="Pipeline-stage sweep: one child per stage count "
+                        "S over the same device total (data axis = "
+                        "total/S, model axis 1), at FIXED GLOBAL BATCH "
+                        "--batch_size x --pp_micro — records ms/step "
+                        "plus the MEASURED pipeline-bubble fraction next "
+                        "to the static (S-1)/(A+S-1) prediction per "
+                        "shape (record: BENCH_r15.json; chip paste in "
+                        "RUNBOOK section 21).  S=1 runs the plain "
+                        "grad-accum step as the bubble-free baseline.  "
+                        "Uses --sweep_platform like --sweep")
+    p.add_argument("--pp_micro", default=4, type=int, metavar="A",
+                   help="Micro-batches per optimizer step for the "
+                        "pipelined bench paths (default 4): the 1F1B "
+                        "schedule's A — bubble prediction is "
+                        "(S-1)/(A+S-1)")
     p.add_argument("--auto_plan", default=None, metavar="PLAN.json",
                    help="Steady-state step bench under a searched "
                         "sharding plan (python -m ddp_tpu.parallel.tp "
@@ -376,7 +395,7 @@ def main() -> None:
     args = _parse_args()
     if args.dump_hlo and (args.sweep or args.pipeline or args.e2e
                           or args.batch_sweep or args.stream_attr
-                          or args.serve or args.tp_sweep
+                          or args.serve or args.tp_sweep or args.pp_sweep
                           or args.ckpt_bench or args.ckpt_bench_child
                           or args.calibrate_cost or args.guard_overhead
                           or args.autoplan_bench or args.mem_ledger
@@ -418,6 +437,9 @@ def main() -> None:
         return
     if args.tp_sweep:
         _bench_tp_sweep(args)
+        return
+    if args.pp_sweep:
+        _bench_pp_sweep(args)
         return
     if args.batch_sweep:
         _bench_batch_sweep(args)
@@ -485,22 +507,43 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         if auto_doc["model"] != args.model:
             raise SystemExit(f"--auto_plan was searched for "
                              f"{auto_doc['model']!r}, not {args.model!r}")
-        d, m = (int(v) for v in auto_doc["mesh_shape"])
-        d_m = (d, m)
-        mesh_shape = f"{d},{m}"
-        mesh = make_mesh(shape=d_m)
+        dims = tuple(int(v) for v in auto_doc["mesh_shape"])
+        d_m = dims[:2]
+        pp_s = dims[2] if len(dims) > 2 else 1
+        mesh_shape = ",".join(map(str, dims))
+        mesh = make_mesh(shape=dims)
         if auto_doc.get("zero"):
             args.shard_update = True
     elif mesh_shape:
         try:
-            d, m = (int(x) for x in mesh_shape.split(","))
+            dims = tuple(int(x) for x in mesh_shape.split(","))
+            if len(dims) not in (2, 3) or min(dims) < 1:
+                raise ValueError(mesh_shape)
         except ValueError:
-            raise SystemExit(f"--mesh_shape wants 'D,M' (e.g. 2,4), got "
-                             f"{mesh_shape!r}")
-        d_m = (d, m)
-        mesh = make_mesh(shape=d_m)
+            raise SystemExit(f"--mesh_shape wants 'D,M' or 'D,M,S' (e.g. "
+                             f"2,4 or 2,1,2), got {mesh_shape!r}")
+        d_m = dims[:2]
+        pp_s = dims[2] if len(dims) > 2 else 1
+        mesh = make_mesh(shape=dims)
     else:
+        pp_s = 1
         mesh = make_mesh(args.num_devices)
+    if pp_s > 1:
+        if args.shard_update:
+            raise SystemExit("--shard_update does not compose with a "
+                             "staged mesh: the pipeline update is already "
+                             "per-stage (each stage owns only its own "
+                             "params/momentum)")
+        if args.dispatch == "scan":
+            raise SystemExit("--dispatch scan cannot wrap the pipeline "
+                             "step (the 1F1B schedule is a host-driven op "
+                             "loop, not one jittable program); use "
+                             "--dispatch step with a staged --mesh_shape")
+        if getattr(args, "dump_hlo", None):
+            raise SystemExit("--dump_hlo has no single program to dump "
+                             "under a staged mesh (one jitted program per "
+                             "stage x role); audit them with python -m "
+                             "ddp_tpu.analysis --mesh-shape D,M,S instead")
     n_chips = mesh.devices.size
     model = get_model(args.model)
     params, stats = model.init(jax.random.key(0))
@@ -514,7 +557,22 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
     schedule = functools.partial(triangular_lr, base_lr=0.4, num_epochs=20,
                                  steps_per_epoch=98)
     compute_dtype = jnp.bfloat16 if bf16 else None
-    if args.shard_update:
+    pp_plan = None
+    if pp_s > 1:
+        from ddp_tpu.obs.tracer import get_tracer
+        from ddp_tpu.parallel.pp import plan_stages
+        from ddp_tpu.parallel.pp.schedule import make_pp_step, place_state
+        pp_plan = plan_stages(args.model, pp_s, model_size=d_m[1],
+                              params=jax.device_get(params),
+                              batch_stats=stats)
+        # tracer: the first call per micro-count A is per-op timed, which
+        # is what fills step_fn.bubble (the measured-vs-predicted record).
+        step_fn = make_pp_step(args.model, SGDConfig(), schedule, mesh,
+                               pp_plan, compute_dtype=compute_dtype,
+                               tp_plan=plan, tracer=get_tracer())
+        state = place_state(init_train_state(params, stats), mesh, pp_plan,
+                            tp_plan=plan)
+    elif args.shard_update:
         from ddp_tpu.train.step import TrainState
         from ddp_tpu.train.zero import init_opt_shard, make_train_step_zero
         step_fn = make_train_step_zero(model, SGDConfig(), schedule, mesh,
@@ -527,16 +585,27 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         step_fn = make_train_step(model, SGDConfig(), schedule, mesh,
                                   compute_dtype=compute_dtype, plan=plan)
         state = init_train_state(params, stats)
-    if plan is not None:
+    if plan is not None and pp_s == 1:
         from ddp_tpu.parallel.tp.plan import state_shardings
         state = jax.device_put(
             state, state_shardings(plan, mesh, zero=args.shard_update))
 
     from ddp_tpu.parallel.mesh import data_axis_size
     global_batch = args.batch_size * data_axis_size(mesh)
-    ds, _ = synthetic(n_train=global_batch, n_test=1)
-    batch = shard_batch({"image": ds.images.astype(np.float32) / 255.0,
-                         "label": ds.labels}, mesh)
+    if pp_s > 1:
+        from ddp_tpu.parallel.pp.schedule import pp_shard_fn
+        pp_a = max(int(getattr(args, "pp_micro", 4)), 1)
+        ds, _ = synthetic(n_train=global_batch * pp_a, n_test=1)
+        imgs = (ds.images.astype(np.float32) / 255.0).reshape(
+            (pp_a, global_batch) + ds.images.shape[1:])
+        batch = pp_shard_fn(pp_plan)(
+            {"image": imgs,
+             "label": ds.labels.reshape(pp_a, global_batch)}, mesh)
+    else:
+        pp_a = 1
+        ds, _ = synthetic(n_train=global_batch, n_test=1)
+        batch = shard_batch({"image": ds.images.astype(np.float32) / 255.0,
+                             "label": ds.labels}, mesh)
     rng = jax.random.key(0)
 
     def time_windows(run_window) -> list:
@@ -560,7 +629,7 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         #               by construction (VERDICT r5 weak #1); min(dts) is
         #               the steady-state capability bound and stays in the
         #               record as best_window_ms_per_step
-        sps_chip = global_batch * args.steps / dt / n_chips
+        sps_chip = global_batch * pp_a * args.steps / dt / n_chips
         # vs_baseline only against a MATCHING-mode recorded constant (a
         # cross-mode ratio misreads as regression/progress — VERDICT r2
         # weak #2); no constant is recorded for the zero-sharded or
@@ -568,8 +637,10 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
         base = (None if args.shard_update or mesh_shape
                 else BASELINE_BENCH_BF16 if bf16 else BASELINE_BENCH)
         vs = sps_chip / base if base else 1.0
+        axes_tag = "data x model x stage" if pp_s > 1 else "data x model"
+        micro_tag = f"{pp_a} micro-batches/step, " if pp_s > 1 else ""
         mesh_tag = ((f"{'auto-plan ' if auto_doc is not None else ''}"
-                     f"mesh {mesh_shape} (data x model), ")
+                     f"mesh {mesh_shape} ({axes_tag}), {micro_tag}")
                     if mesh_shape else "")
         rec = {
             "metric": f"{args.model} train samples/sec/chip "
@@ -669,6 +740,13 @@ def _bench_step(args, *, bf16: bool, extras: bool = True) -> list:
     scan_extra = {"scan_unroll": args.steps if _su is True else int(_su),
                   "scan_rolled": _su is not True and int(_su) < args.steps}
     primary_is_step = args.dispatch == "step"
+    if pp_s > 1:
+        # The pipelined step has exactly one dispatch flavor (the host-
+        # driven 1F1B op loop); its record carries the bubble accounting
+        # the warmup's per-op timed pass measured.
+        pp_extra = {"pp": dict(step_fn.bubble or {})}
+        return [record(f"{args.steps}-step window, 1F1B pipeline dispatch",
+                       time_windows(step_window), extra=pp_extra)]
     if not primary_is_step or (extras and args.profile_dir is None):
         float(scan_window())  # compile the scanned program when needed
     primary = step_window if primary_is_step else scan_window
@@ -1266,6 +1344,69 @@ def _bench_tp_sweep(args) -> None:
         "unit": f"ms/step ratio, {shapes[0]} vs {shapes[-1]} (data x model)",
         "vs_baseline": 1.0,
         "tp_sweep": per,
+    }))
+
+
+def _bench_pp_sweep(args) -> None:
+    """Pipeline-stage sweep at FIXED GLOBAL BATCH: one child per stage
+    count S over the same device total (data axis = total/S, model axis
+    1), each stepping --pp_micro micro-batches through the 1F1B
+    schedule, recording ms/step, samples/sec/chip AND the pipeline
+    bubble — the MEASURED idle fraction (per-op timed critical path,
+    parallel/pp/schedule.py) next to the static (S-1)/(A+S-1) prediction
+    — per mesh shape.  S=1 runs the plain single-dispatch step on the
+    same devices as the bubble-free baseline.  Emits ONE JSON line whose
+    ``pp_sweep`` dict is keyed by mesh shape ("8x1x1", "4x1x2",
+    "2x1x4"); committed CPU-box record: BENCH_r15.json (chip paste in
+    RUNBOOK section 21)."""
+    ss = sorted(int(x) for x in args.pp_sweep.split(","))
+    total = args.num_devices or jax.device_count()
+    global_batch = args.batch_size
+    a = max(int(args.pp_micro), 1)
+    per: dict = {}
+    for s in ss:
+        if total % s:
+            raise SystemExit(f"--pp_sweep: stage count {s} does not "
+                             f"divide the device total {total}")
+        d = total // s
+        if global_batch % d:
+            raise SystemExit(f"--pp_sweep: global batch {global_batch} "
+                             f"not divisible by the {d}-way data axis "
+                             f"at s={s}")
+        env = dict(os.environ)
+        child = [sys.executable, os.path.abspath(__file__),
+                 "--model", args.model,
+                 "--batch_size", str(global_batch // d),
+                 "--steps", str(args.steps), "--warmup", str(args.warmup),
+                 "--repeats", str(args.repeats),
+                 "--mesh_shape", f"{d},1,{s}",
+                 "--pp_micro", str(a),
+                 "--no_bf16", "--primary_only", "--dispatch", "step"]
+        child += ["--bf16"] if args.bf16 else []
+        if args.sweep_platform == "cpu":
+            from ddp_tpu.utils.platform import cpu_device_env
+            env = cpu_device_env(total, env)
+        rec = _run_child(child, env, f"pp sweep child s={s}")
+        per[f"{d}x1x{s}"] = {
+            "ms_per_step": rec["median_ms_per_step"],
+            "best_window_ms_per_step": rec["best_window_ms_per_step"],
+            "samples_per_sec_per_chip": rec["value"],
+            "pp": rec.get("pp"),
+        }
+    shapes = [f"{total // s}x1x{s}" for s in ss]
+    deepest = per[shapes[-1]].get("pp") or {}
+    print(json.dumps({
+        "metric": f"{args.model} pipeline-stage mesh sweep "
+                  f"({args.sweep_platform} mesh, global batch "
+                  f"{global_batch} x {a} micro-batches/step, {total} "
+                  f"devices, {'bf16' if args.bf16 else 'fp32'}, 1F1B, "
+                  f"shapes {shapes})",
+        "value": round(deepest.get("bubble_measured", 0.0), 4),
+        "unit": (f"measured bubble fraction at {shapes[-1]} "
+                 f"(static prediction "
+                 f"{round(deepest.get('bubble_predicted', 0.0), 4)})"),
+        "vs_baseline": 1.0,
+        "pp_sweep": per,
     }))
 
 

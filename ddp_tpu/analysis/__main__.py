@@ -50,9 +50,12 @@ def _parse(argv: Optional[List[str]]) -> argparse.Namespace:
                    help="model to build programs for (default: the "
                         "registry default, deepnn)")
     p.add_argument("--mesh-shape", "--mesh_shape", dest="mesh_shape",
-                   default=None, metavar="D,M",
-                   help="(data, model) mesh shape, default 2,4; the 1-D "
-                        "programs use all D*M devices")
+                   default=None, metavar="D,M[,S]",
+                   help="(data, model[, pipeline stage]) mesh shape, "
+                        "default 2,4; the 1-D programs use all D*M "
+                        "devices; a third entry S>1 also audits the "
+                        "staged pipeline programs (pp_*@pp) on a "
+                        "(D,M,S) mesh of D*M*S devices")
     from .fixtures import fixture_names
     p.add_argument("--fixture", metavar="NAME",
                    help="run one seeded-faulty fixture instead of the "
@@ -91,9 +94,14 @@ def _mesh_shape(arg: Optional[str]):
     from .programs import DEFAULT_MESH_2D
     if not arg:
         return DEFAULT_MESH_2D
-    parts = [int(v) for v in arg.replace("x", ",").split(",") if v]
-    if len(parts) != 2 or min(parts) < 1:
-        raise SystemExit(f"--mesh-shape wants D,M (got {arg!r})")
+    try:
+        parts = [int(v) for v in arg.replace("x", ",").split(",") if v]
+    except ValueError:
+        parts = []
+    if len(parts) not in (2, 3) or min(parts, default=0) < 1:
+        raise SystemExit(
+            f"--mesh-shape wants 'D,M' or 'D,M,S' — positive ints in "
+            f"(data, model, pipeline stage) order (got {arg!r})")
     return tuple(parts)
 
 
@@ -103,11 +111,32 @@ def _default_budgets_path() -> str:
         os.path.abspath(__file__)))), "BUDGETS.json")
 
 
+def _select_budgets(budgets: dict, model_name, mesh_shape) -> dict:
+    """The budget section applying to this (model, mesh): the top-level
+    document, or a matching ``extra_contexts`` entry (the per-mesh
+    sections ``--write-budgets`` appends for non-default shapes, e.g.
+    the staged-pipeline (2,1,2) audit).  Falls back to the top-level doc
+    so a genuinely un-budgeted context still gets check_budgets' single
+    not-comparable info finding, never a silent pass."""
+    def matches(doc):
+        return (doc.get("model") == model_name
+                and list(doc.get("mesh_shape") or ()) == list(mesh_shape))
+    if matches(budgets):
+        return budgets
+    for doc in budgets.get("extra_contexts", ()):
+        if matches(doc):
+            return doc
+    return budgets
+
+
 def _budget_pass(args, cost_table, model_name, mesh_shape, *,
                  partial: bool, out):
     """Write or diff the per-program budget file.  Diffing is skipped
     (silently) when no budget file exists — a fresh checkout without a
-    baseline must not fail ``--strict``."""
+    baseline must not fail ``--strict``.  One file carries every audited
+    context: the default (2,4) document at top level, other (model,
+    mesh) pairs as ``extra_contexts`` entries; ``--write-budgets``
+    updates only the section matching the current audit."""
     from .costmodel import check_budgets, make_budgets
     path = args.budgets or _default_budgets_path()
     if args.write_budgets:
@@ -116,6 +145,25 @@ def _budget_pass(args, cost_table, model_name, mesh_shape, *,
                          "collective_payload_bytes")}
                  for name, row in cost_table.items()}
         doc = make_budgets(table, model_name, mesh_shape)
+        existing = {}
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as fh:
+                existing = json.load(fh)
+        top_matches = (not existing or
+                       (existing.get("model") == model_name and
+                        list(existing.get("mesh_shape") or ())
+                        == list(mesh_shape)))
+        if top_matches:
+            extras = existing.get("extra_contexts")
+            if extras:
+                doc["extra_contexts"] = extras
+        else:
+            doc, top = existing, doc
+            extras = [e for e in doc.get("extra_contexts", ())
+                      if not (e.get("model") == model_name and
+                              list(e.get("mesh_shape") or ())
+                              == list(mesh_shape))]
+            doc["extra_contexts"] = extras + [top]
         with open(path, "w", encoding="utf-8") as fh:
             json.dump(doc, fh, indent=2, sort_keys=True)
             fh.write("\n")
@@ -125,8 +173,9 @@ def _budget_pass(args, cost_table, model_name, mesh_shape, *,
         return []
     with open(path, "r", encoding="utf-8") as fh:
         budgets = json.load(fh)
-    return check_budgets(cost_table, budgets, model_name, mesh_shape,
-                         partial=partial)
+    return check_budgets(cost_table,
+                         _select_budgets(budgets, model_name, mesh_shape),
+                         model_name, mesh_shape, partial=partial)
 
 
 def _inventory_summary(inv) -> str:
@@ -153,7 +202,10 @@ def run(argv: Optional[List[str]] = None,
         return 0
 
     mesh_shape = _mesh_shape(args.mesh_shape)
-    _prepare_backend(mesh_shape[0] * mesh_shape[1])
+    n_devices = 1
+    for v in mesh_shape:
+        n_devices *= v
+    _prepare_backend(n_devices)
 
     from .findings import count_by_severity, format_table, make_finding
 
@@ -193,7 +245,8 @@ def run(argv: Optional[List[str]] = None,
                     cost_summary(cost, live["peak_live_bytes"])))
                 findings.extend(audit_collectives(
                     prog.name, prog.kind, inv, plan=prog.plan,
-                    zero=prog.zero))
+                    zero=prog.zero,
+                    model_psum_budget=prog.model_psum_budget))
                 findings.extend(audit_constants(prog.name, closed))
                 findings.extend(audit_donation(
                     prog.name, prog.kind, prog.fn, prog.args))

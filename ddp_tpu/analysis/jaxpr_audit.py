@@ -26,7 +26,7 @@ from typing import Dict, Iterator, List, Tuple
 import jax
 import numpy as np
 
-from ..parallel.mesh import DATA_AXIS, MODEL_AXIS
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, STAGE_AXIS
 from .findings import Finding, make_finding
 
 # Named-axis communication primitives.  axis_index is deliberately absent
@@ -95,7 +95,8 @@ def _count(inv: Dict, prim: str, axis: str) -> int:
 
 
 def audit_collectives(name: str, kind: str, inv: Dict,
-                      plan=None, zero: bool = False) -> List[Finding]:
+                      plan=None, zero: bool = False,
+                      model_psum_budget=None) -> List[Finding]:
     """Check one program's collective inventory against its declarative
     invariants.
 
@@ -106,11 +107,25 @@ def audit_collectives(name: str, kind: str, inv: Dict,
     collective), ``eval`` (the counter-psum evaluation step), or
     ``audit`` (the drift-audit fingerprint program — only the generic
     invariants apply: data-axis psums allowed, everything else banned).
+    The staged pipeline programs (parallel/pp/schedule.py) add
+    ``pp_forward`` (a stage forward: NO data-axis collectives — it only
+    computes an activation), ``pp_backward`` / ``pp_fwdbwd`` (stage
+    backward / fused last-stage forward+backward: the per-stage gsum
+    reduction must psum over ``data``), and ``pp_update`` (per-stage SGD:
+    collective-free on EVERY axis — the grads arrive pre-reduced, a psum
+    here would double-count the data axis).
     ``plan`` (a TPPlan) switches on the model-axis budget from
     ``expected_collectives`` — the printed plan table's numbers; without a
-    plan, ANY model-axis traffic is a wrong-axis collective.  ``zero``
-    allows (and requires) the ZeRO update's single
-    ``reduce_scatter``/``all_gather`` pair over ``data``.
+    plan, ANY model-axis traffic is a wrong-axis collective.
+    ``model_psum_budget`` (the pp entries) pins the model-psum count to an
+    EXACT per-stage number instead (``pp/partition.stage_model_psums``)
+    and takes precedence over ``plan``.  ``zero`` allows (and requires)
+    the ZeRO update's single ``reduce_scatter``/``all_gather`` pair over
+    ``data``.
+
+    The stage axis never appears here at all: stage handoff is an
+    explicit device transfer between per-stage 2-D programs, so ANY
+    collective over ``stage`` is an error regardless of kind.
     """
     out: List[Finding] = []
 
@@ -120,14 +135,28 @@ def audit_collectives(name: str, kind: str, inv: Dict,
     # -- axis whitelist: nothing may touch an axis we don't know ---------
     known = {DATA_AXIS, MODEL_AXIS}
     for (prim, axes), n in sorted(inv.items()):
-        stray = [a for a in axes if a not in known]
+        if STAGE_AXIS in axes:
+            err("collective-axis",
+                f"{prim} over '{STAGE_AXIS}' x{n} — stage handoff is an "
+                "explicit device transfer between per-stage programs "
+                "(parallel/pp/schedule.py), never a collective; every "
+                "staged jaxpr must stay 2-D (data × model)")
+        stray = [a for a in axes if a not in known and a != STAGE_AXIS]
         if stray:
             err("collective-axis",
                 f"{prim} over unknown axis {stray} (x{n})")
 
     # -- model-axis budget ----------------------------------------------
     model_psums = _count(inv, "psum", MODEL_AXIS)
-    if plan is not None:
+    if model_psum_budget is not None:
+        if model_psums != int(model_psum_budget):
+            err("collective-count",
+                f"psum over '{MODEL_AXIS}' x{model_psums}, the stage plan "
+                f"expects exactly x{int(model_psum_budget)} for this "
+                "stage program (stage_model_psums) — a stage cut moved a "
+                "TP layer's collective, or a reduction landed on the "
+                "wrong axis")
+    elif plan is not None:
         from ..parallel.tp.plan import expected_collectives
         exp = expected_collectives(plan, backward=(kind == "update"))
         if model_psums != exp["psum_model"]:
@@ -154,20 +183,34 @@ def audit_collectives(name: str, kind: str, inv: Dict,
 
     # -- per-kind data-axis shape ----------------------------------------
     data_psums = _count(inv, "psum", DATA_AXIS)
+    data_coll = sum(n for (p, axes), n in inv.items() if DATA_AXIS in axes)
     if kind == "update" and data_psums == 0:
         err("collective-count",
             f"no psum over '{DATA_AXIS}' in an update program — the "
             "gradient/loss all-reduce is missing; shards would train on "
             "their local batches only and silently diverge")
-    if kind == "forward":
-        data_coll = sum(n for (p, axes), n in inv.items()
-                        if DATA_AXIS in axes)
-        if data_coll:
-            err("collective-count",
-                f"{data_coll} data-axis collective(s) in a serve forward "
-                "— per-row logits are independent; the batch gather is "
-                "an output sharding, not a collective, so this program "
-                "must be collective-free on the data axis")
+    if kind == "forward" and data_coll:
+        err("collective-count",
+            f"{data_coll} data-axis collective(s) in a serve forward "
+            "— per-row logits are independent; the batch gather is "
+            "an output sharding, not a collective, so this program "
+            "must be collective-free on the data axis")
+    if kind == "pp_forward" and data_coll:
+        err("collective-count",
+            f"{data_coll} data-axis collective(s) in a pipeline stage "
+            "forward — a stage forward only computes its activation "
+            "shard; nothing is reduced until the backward's gsum psum")
+    if kind in ("pp_backward", "pp_fwdbwd") and data_psums == 0:
+        err("collective-count",
+            f"no psum over '{DATA_AXIS}' in a pipeline stage backward — "
+            "the per-stage gsum reduction is missing; the stage's data "
+            "shards would accumulate local gradients only and silently "
+            "diverge")
+    if kind == "pp_update" and data_coll:
+        err("collective-count",
+            f"{data_coll} data-axis collective(s) in a per-stage update "
+            "— the stage's grads arrive pre-reduced from the backward "
+            "programs; a reduction here double-counts the data axis")
 
     # -- ZeRO pair -------------------------------------------------------
     rs_data = _count(inv, "reduce_scatter", DATA_AXIS)
@@ -270,7 +313,13 @@ def audit_donation(name: str, kind: str, fn, args) -> List[Finding]:
     state (params + momentum are the overwhelming majority of live HBM in
     data-parallel training — the reuse ``donate_argnums=(0,)`` exists
     for).  Forward/eval programs are exempt: their params are shared
-    across calls and must NOT be donated."""
+    across calls and must NOT be donated.  The staged ``pp_*`` programs
+    are exempt too: their params persist across the whole microbatch
+    schedule (donating them in any one program would kill the others),
+    gsum IS donated where it can alias (the backward/FB accumulators),
+    and the per-stage update deliberately leaves gsum undonated — its
+    outputs already alias params+momentum, so a third donation has no
+    buffer to reuse (see schedule._update_programs)."""
     if kind != "update":
         return []
     try:

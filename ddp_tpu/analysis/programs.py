@@ -23,6 +23,12 @@ build), invariants derive from (kind, zero, plan) in
   psum-over-data only, params NOT donated (they are the live train state),
   payload budgeted tiny (2 x n_leaves x 4 bytes — the SDC audit must stay
   cheap enough to run every K steps, BENCH_r10.json).
+- ``pp_*``    — the staged pipeline programs (parallel/pp/schedule.py),
+  registered only under a 3-entry ``--mesh-shape`` with s>1: one
+  forward/backward per non-last stage, the fused last-stage FB, one
+  update per stage — each audited against its EXACT per-stage
+  psum-over-model budget and required to stay 2-D (activation handoffs
+  are device transfers, never collectives).
 """
 from __future__ import annotations
 
@@ -41,11 +47,15 @@ _ACCUM = 2       # micro-batches for the accum variants
 
 class BuiltProgram(NamedTuple):
     name: str
-    kind: str                 # "update" | "forward" | "eval"
+    kind: str                 # "update" | "forward" | "eval" | "pp_*"
     zero: bool
     fn: Any                   # the jitted callable (head builder output)
     args: Tuple               # abstract example args for make_jaxpr/lower
     plan: Optional[Any]       # TPPlan when tensor-parallel, else None
+    # Exact psum-over-model budget for the pp_* kinds (the per-stage slice
+    # of expected_collectives — parallel/pp/partition.stage_model_psums);
+    # None everywhere else (the TPPlan drives the budget instead).
+    model_psum_budget: Optional[int] = None
 
 
 class ProgramSpec(NamedTuple):
@@ -58,7 +68,10 @@ class ProgramSpec(NamedTuple):
 
 class _Ctx(NamedTuple):
     """Shared build context: model + meshes + abstract state, built once
-    per audit run (model init is the only concrete computation)."""
+    per audit run (model init is the only concrete computation).
+    ``mesh3d``/``pp_plan`` exist only under a 3-entry ``--mesh-shape``
+    with s>1 AND a model that declares PP_BLOCKS — the staged programs
+    are registered exactly then."""
     model: Any
     mesh1d: Any
     mesh2d: Any
@@ -66,6 +79,8 @@ class _Ctx(NamedTuple):
     params: Any
     stats: Any
     model_name: str = DEFAULT_MODEL
+    mesh3d: Any = None
+    pp_plan: Optional[Any] = None
 
 
 def _sds(tree):
@@ -213,6 +228,77 @@ def _build_auto(ctx: _Ctx, name: str) -> BuiltProgram:
                         (state, _batch(), _rng()), plan)
 
 
+def _pp_names(pp_plan) -> List[str]:
+    """Registry names of the staged programs a context with this stage
+    plan registers — one forward/backward per non-last stage, the fused
+    forward+backward on the last, one update per stage."""
+    s = pp_plan.num_stages
+    return ([f"pp_fwd_s{j}@pp" for j in range(s - 1)]
+            + ["pp_fb@pp"]
+            + [f"pp_bwd_s{j}@pp" for j in range(s - 1)]
+            + [f"pp_update_s{k}@pp" for k in range(s)])
+
+
+def _pp_programs(ctx: _Ctx) -> List[BuiltProgram]:
+    """The pipeline stage programs, built through the REAL schedule
+    (parallel/pp/schedule._PPStep) over the context's 3-D mesh — the
+    exact per-stage jitted shard_map programs a (d, m, s) train step
+    dispatches, traced with abstract args.  Each carries its exact
+    psum-over-model budget (``stage_model_psums``); activation handoffs
+    are device transfers OUTSIDE these programs, so every staged jaxpr
+    must stay 2-D — the stage-axis invariant jaxpr_audit enforces."""
+    from ..parallel.pp.partition import stage_model_psums, stage_subtree
+    from ..parallel.pp.schedule import _PPStep
+    cfg, sched = _sgd()
+    step = _PPStep(ctx.model_name, cfg, sched, ctx.mesh3d, ctx.pp_plan,
+                   tp_plan=ctx.plan, schedule="1f1b")
+    state = _train_state(ctx, ctx.mesh3d, zero=False, plan=None)
+    step._build(state)
+    progs = step._progs
+    updates = step._update_programs(_ACCUM)
+    plan, s = ctx.pp_plan, ctx.pp_plan.num_stages
+    p_sub = [stage_subtree(plan, k, state.params) for k in range(s)]
+    imgs, labels = (_batch(stacked=True)["image"],
+                    _batch(stacked=True)["label"])
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    lsum = jax.ShapeDtypeStruct((), jnp.float32)
+    rng = _rng()
+
+    def budget(k, role):
+        return stage_model_psums(plan, ctx.plan, k, role=role)
+
+    # Activation ShapeDtypeStructs, chained through the real forwards.
+    acts, x = {}, imgs
+    for j in range(s - 1):
+        acts[j + 1] = jax.eval_shape(progs["fwd"][j], p_sub[j], x, rng,
+                                     i32, i32)
+        x = acts[j + 1]
+
+    out: List[BuiltProgram] = []
+    for j in range(s - 1):
+        xin = imgs if j == 0 else acts[j]
+        out.append(BuiltProgram(
+            f"pp_fwd_s{j}@pp", "pp_forward", False, progs["fwd"][j],
+            (p_sub[j], xin, rng, i32, i32), None,
+            model_psum_budget=budget(j, "forward")))
+    out.append(BuiltProgram(
+        "pp_fb@pp", "pp_fwdbwd", False, progs["fb"],
+        (p_sub[s - 1], p_sub[s - 1], lsum, acts[s - 1], labels, rng,
+         i32, i32), None, model_psum_budget=budget(s - 1, "fwdbwd")))
+    for j in range(s - 1):
+        xin = imgs if j == 0 else acts[j]
+        out.append(BuiltProgram(
+            f"pp_bwd_s{j}@pp", "pp_backward", False, progs["bwd"][j],
+            (p_sub[j], p_sub[j], xin, acts[j + 1], rng, i32, i32), None,
+            model_psum_budget=budget(j, "backward")))
+    for k in range(s):
+        out.append(BuiltProgram(
+            f"pp_update_s{k}@pp", "pp_update", False, updates[k],
+            (p_sub[k], p_sub[k], p_sub[k], i32), None,
+            model_psum_budget=budget(k, "update")))
+    return out
+
+
 def _spec(name, kind, *, zero=False, tp=False, accum=False,
           auto=False) -> ProgramSpec:
     if auto:
@@ -256,13 +342,17 @@ def program_names() -> List[str]:
 
 
 def build_context(model_name: str = DEFAULT_MODEL,
-                  mesh_2d: Tuple[int, int] = DEFAULT_MESH_2D) -> _Ctx:
+                  mesh_2d: Tuple[int, ...] = DEFAULT_MESH_2D) -> _Ctx:
     """Meshes + model + plan, shared by every registry build.  The 1-D
     mesh spans d*m devices so both regimes audit the same device budget
-    (CI: the (2,4)x8 virtual mesh)."""
+    (CI: the (2,4)x8 virtual mesh).  A 3-entry shape (d, m, s) with s>1
+    additionally builds the (data × model × stage) mesh and the stage
+    plan, registering the staged pipeline programs (``pp_*@pp``) — the
+    backend then needs d*m*s virtual devices."""
     from ..models import get_model
     from ..parallel.mesh import make_mesh
-    d, m = mesh_2d
+    d, m = int(mesh_2d[0]), int(mesh_2d[1])
+    s = int(mesh_2d[2]) if len(mesh_2d) > 2 else 1
     model = get_model(model_name)
     params, stats = model.init(jax.random.key(0))
     mesh1d = make_mesh(d * m)
@@ -274,18 +364,31 @@ def build_context(model_name: str = DEFAULT_MODEL,
             plan = plan_for_model(model_name, params, stats, model_size=m)
         except ValueError:
             plan = None  # model without a recipe: tp entries are skipped
-    return _Ctx(model, mesh1d, mesh2d, plan, params, stats, model_name)
+    mesh3d, pp_plan = None, None
+    if s > 1:
+        from ..parallel.pp.partition import plan_stages
+        try:
+            pp_plan = plan_stages(model_name, s, model_size=m,
+                                  params=params, batch_stats=stats)
+            mesh3d = make_mesh(shape=(d, m, s))
+        except ValueError:
+            pp_plan = None  # no PP_BLOCKS / infeasible cut: pp skipped
+    return _Ctx(model, mesh1d, mesh2d, plan, params, stats, model_name,
+                mesh3d, pp_plan)
 
 
 def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
     """Build the selected registry entries (default: every entry the
     context supports — tp entries are skipped when the model has no
-    TP_RECIPE/plan)."""
+    TP_RECIPE/plan, the staged ``pp_*@pp`` entries exist only under a
+    3-D context with a stage plan)."""
+    pp_names = _pp_names(ctx.pp_plan) if ctx.pp_plan is not None else []
+    known = set(program_names()) | set(pp_names)
     wanted = set(names) if names else None
-    unknown = (wanted or set()) - set(program_names())
+    unknown = (wanted or set()) - known
     if unknown:
         raise ValueError(f"unknown program(s) {sorted(unknown)}; "
-                         f"registry has {program_names()}")
+                         f"registry has {program_names() + pp_names}")
     out = []
     for spec in REGISTRY:
         if wanted is not None and spec.name not in wanted:
@@ -295,4 +398,8 @@ def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
         if spec.name.endswith("@auto") and _auto_doc(ctx) is None:
             continue
         out.append(spec.build(ctx, spec.name))
+    if pp_names and (wanted is None or wanted & set(pp_names)):
+        built = _pp_programs(ctx)
+        out.extend(p for p in built
+                   if wanted is None or p.name in wanted)
     return out

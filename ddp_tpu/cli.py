@@ -84,17 +84,35 @@ def build_parser(description: str) -> argparse.ArgumentParser:
     p.add_argument("--seed", default=0, type=int)
     p.add_argument("--num_devices", default=None, type=int,
                    help="Mesh size override (default: entry-point specific)")
-    p.add_argument("--mesh_shape", default=None, metavar="D,M",
+    p.add_argument("--mesh_shape", default=None, metavar="D,M[,S]",
                    help="2-D (data x model) mesh for tensor-model "
                         "parallelism (parallel/tp/): D-way data parallel "
                         "x M-way model parallel over the first D*M "
                         "devices, params sharded per the model's "
                         "TP_RECIPE (plan table printed at startup; "
                         "python -m ddp_tpu.parallel.tp shows it offline). "
-                        "Batches split over the data axis only; "
-                        "checkpoints stay canonical, so snapshots "
-                        "interchange with any other mesh shape (incl. "
-                        "1-D serving).  Default: 1-D data-parallel mesh")
+                        "A third S entry adds S-way PIPELINE parallelism "
+                        "(parallel/pp/): the model's PP_BLOCKS are cut "
+                        "into S balanced stages (stage table printed at "
+                        "startup; python -m ddp_tpu.parallel.pp shows it "
+                        "offline) and each optimizer step runs "
+                        "--grad_accum micro-batches through the "
+                        "--pp_schedule pipeline.  S=1 is bit-identical "
+                        "to the 2-D mesh.  Batches split over the data "
+                        "axis only; checkpoints stay canonical, so "
+                        "snapshots interchange with any other mesh shape "
+                        "(incl. 1-D serving).  Default: 1-D data-parallel "
+                        "mesh")
+    p.add_argument("--pp_schedule", default="1f1b",
+                   choices=("1f1b", "gpipe"),
+                   help="Microbatch schedule for the pipeline stage axis "
+                        "(--mesh_shape D,M,S with S>1): '1f1b' "
+                        "interleaves one-forward-one-backward (min(S,A) "
+                        "in-flight activations), 'gpipe' runs all "
+                        "forwards then all backwards (A in flight).  "
+                        "Same math, bit-identical results, same bubble "
+                        "fraction (S-1)/(A+S-1) — the choice is an "
+                        "activation-memory knob")
     p.add_argument("--auto_plan", default=None, metavar="PLAN.json",
                    help="Train under a searched sharding plan "
                         "(python -m ddp_tpu.parallel.tp --search --out "
@@ -384,6 +402,23 @@ def main(args: argparse.Namespace, *, num_devices: Optional[int]) -> None:
     run(args, num_devices=num_devices)
 
 
+def _parse_mesh_shape(text: str) -> tuple:
+    """``--mesh_shape`` 'D,M' / 'D,M,S' (or x-separated) as an int tuple.
+    Rejections name all three axes — the flag's contract is the mesh's
+    (data, model, stage) order, and the error must say so rather than
+    surface an unpacking traceback."""
+    try:
+        dims = tuple(int(x) for x in str(text).replace("x", ",").split(","))
+    except ValueError:
+        dims = ()
+    if len(dims) not in (2, 3) or any(v < 1 for v in dims):
+        raise SystemExit(
+            f"--mesh_shape wants 'D,M' or 'D,M,S' — positive ints, in "
+            f"(data, model, pipeline stage) order, e.g. 2,4 or 2,1,2 — "
+            f"got {text!r}")
+    return dims
+
+
 def _preflight_audit(args: argparse.Namespace) -> None:
     """``--audit``: trace-audit the program families this run will build
     BEFORE any device state exists (ddp_tpu/analysis).  Tracing is
@@ -395,8 +430,8 @@ def _preflight_audit(args: argparse.Namespace) -> None:
     from .analysis.__main__ import run as audit_run
     if getattr(args, "auto_plan", None):
         from .parallel.tp.autoplan import read_plan_doc
-        d, m = read_plan_doc(args.auto_plan)["mesh_shape"]
-        shape = f"{d},{m}"
+        dims = read_plan_doc(args.auto_plan)["mesh_shape"]
+        shape = ",".join(str(int(v)) for v in dims)
     elif args.mesh_shape:
         shape = str(args.mesh_shape)
     else:
@@ -591,29 +626,39 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                 print("auto plan: ZeRO update sharding on "
                       "(plan doc zero=true)")
     if auto_doc is not None:
-        d, m = (int(v) for v in auto_doc["mesh_shape"])
-        if args.mesh_shape and args.mesh_shape.replace("x", ",") != f"{d},{m}":
-            raise SystemExit(
-                f"--mesh_shape {args.mesh_shape} contradicts the auto "
-                f"plan's searched mesh {d},{m}; drop one")
-        if args.num_devices and args.num_devices != d * m:
+        doc_dims = tuple(int(v) for v in auto_doc["mesh_shape"])
+        doc_full = doc_dims + (1,) * (3 - len(doc_dims))
+        doc_str = ",".join(map(str, doc_dims))
+        if args.mesh_shape:
+            dims = _parse_mesh_shape(args.mesh_shape)
+            full = dims + (1,) * (3 - len(dims))
+            if full != doc_full:
+                # Stage-count contradictions get named specifically —
+                # same drop-one contract as the d,m case.
+                detail = (f" (the doc pins pipeline stage count "
+                          f"s={doc_full[2]}, the flag asks s={full[2]})"
+                          if full[:2] == doc_full[:2] else "")
+                raise SystemExit(
+                    f"--mesh_shape {args.mesh_shape} contradicts the auto "
+                    f"plan's searched mesh {doc_str}{detail}; drop one")
+        n_doc = doc_full[0] * doc_full[1] * doc_full[2]
+        if args.num_devices and args.num_devices != n_doc:
             raise SystemExit(
                 f"--num_devices {args.num_devices} contradicts the auto "
-                f"plan's searched mesh {d},{m} (= {d * m} devices); "
+                f"plan's searched mesh {doc_str} (= {n_doc} devices); "
                 "drop one")
-        mesh = make_mesh(shape=(d, m))
+        mesh = make_mesh(shape=doc_dims)
     elif args.mesh_shape:
-        try:
-            d, m = (int(x) for x in args.mesh_shape.split(","))
-        except ValueError:
-            raise SystemExit(
-                f"--mesh_shape wants 'D,M' (e.g. 2,4), got "
-                f"{args.mesh_shape!r}")
-        if args.num_devices and args.num_devices != d * m:
+        dims = _parse_mesh_shape(args.mesh_shape)
+        n_mesh = 1
+        for v in dims:
+            n_mesh *= v
+        if args.num_devices and args.num_devices != n_mesh:
             raise SystemExit(
                 f"--num_devices {args.num_devices} contradicts "
-                f"--mesh_shape {d},{m} (= {d * m} devices); drop one")
-        mesh = make_mesh(shape=(d, m))
+                f"--mesh_shape {','.join(map(str, dims))} (= {n_mesh} "
+                "devices); drop one")
+        mesh = make_mesh(shape=dims)
     else:
         mesh = make_mesh(args.num_devices or num_devices)
     # Batch math divides by the DATA axis only: on a 2-D mesh the model
@@ -669,6 +714,26 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
                                  model_size=model_axis_size(mesh))
         if jax.process_index() == 0:
             print(format_plan_table(tp_plan))
+
+    # Pipeline stage plan (parallel/pp/partition.py): resolved whenever
+    # the mesh grew the third ``stage`` axis — balanced cost-model cut of
+    # the model's PP_BLOCKS, stage table printed at startup like the tp
+    # plan table above.  The microbatch count is the grad-accum group
+    # size: the pipeline injects exactly those micro-batches per
+    # optimizer step, so the predicted-bubble footer describes this run.
+    pp_plan = None
+    from .parallel.mesh import model_axis_size as _masz, stage_axis_size
+    if stage_axis_size(mesh) > 1:
+        from .parallel.pp import format_stage_table, plan_stages
+        try:
+            pp_plan = plan_stages(args.model, stage_axis_size(mesh),
+                                  model_size=_masz(mesh),
+                                  params=params, batch_stats=batch_stats)
+        except ValueError as e:
+            raise SystemExit(f"--mesh_shape: {e}")
+        if jax.process_index() == 0:
+            print(format_stage_table(pp_plan,
+                                     num_micro=max(args.grad_accum, 1)))
 
     # Each host materialises/augments only its own chips' rows (the per-host
     # shard DistributedSampler semantics, multigpu.py:153); single-host this
@@ -774,7 +839,8 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
         return _run_guarded(args, preemption, metrics, model, train_loader,
                             params, batch_stats, mesh, lr_schedule,
                             compute_dtype, device_augment, test_ds,
-                            n_replicas, local_replicas, tracer, tp_plan)
+                            n_replicas, local_replicas, tracer, tp_plan,
+                            pp_plan=pp_plan)
     finally:
         # Handlers must not outlive the run even when construction (e.g. a
         # resume with every checkpoint torn) raises before training starts
@@ -788,7 +854,7 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
 def _run_guarded(args, preemption, metrics, model, train_loader, params,
                  batch_stats, mesh, lr_schedule, compute_dtype,
                  device_augment, test_ds, n_replicas, local_replicas,
-                 tracer, tp_plan=None) -> float:
+                 tracer, tp_plan=None, pp_plan=None) -> float:
     """The trainer-lifetime tail of :func:`_run_body`, inside the
     preemption guard's install/uninstall bracket."""
     from .obs.registry import MetricsRegistry
@@ -997,7 +1063,8 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
                       prefetch_depth=args.prefetch_depth,
                       prefetch_workers=args.prefetch_workers,
                       prefetch_stats=pstats, tracer=tracer, live=live,
-                      tp_plan=tp_plan,
+                      tp_plan=tp_plan, pp_plan=pp_plan,
+                      pp_schedule=getattr(args, "pp_schedule", "1f1b"),
                       ckpt_format=getattr(args, "ckpt_format", "gathered"),
                       drift_audit_every=getattr(args, "drift_audit_every",
                                                 0),
@@ -1054,6 +1121,21 @@ def _run_guarded(args, preemption, metrics, model, train_loader, params,
         # keeps the established evaluate()/evaluate_resident() signature
         # (which tests and callers monkeypatch/spy on).
         tp_kw = {} if tp_plan is None else {"plan": tp_plan}
+        if pp_plan is not None:
+            # Pipeline runs evaluate on stage 0's (data x model) submesh:
+            # the stage-scattered params are gathered back onto it first
+            # (host round-trip — the stages are disjoint device sets), and
+            # the eval itself is the ordinary 2-D program.  d matches the
+            # loader's replica count by construction, so EvalLoader's
+            # sharding carries over unchanged.
+            from .parallel.pp import stage_submesh
+            from .parallel.pp.schedule import eval_params_for
+            emesh = stage_submesh(mesh, 0)
+            eparams, estats = eval_params_for(trainer.state, pp_plan,
+                                              tp_plan, emesh)
+            return evaluate(model, eparams, estats, eval_loader, emesh,
+                            compute_dtype=compute_dtype, progress=progress,
+                            **tp_kw)
         if args.resident:
             from .data.resident import ResidentData
             from .train.evaluate import evaluate_resident

@@ -55,6 +55,29 @@ TP_BARRIERS = ("features/conv3",)
 # (parallel/tp/plan.py:expected_collectives, ddp_tpu/analysis/).
 TP_STEM = "features/conv0"
 
+# Pipeline-parallel block list (parallel/pp/partition.py): the model as an
+# ordered sequence of cut-able units, one per TP_RECIPE layer, each block
+# owning the layer plus its trailing elementwise/pool/reshape ops so a cut
+# between any two blocks is a clean activation handoff.  Block names ARE
+# the recipe paths — the pp planner prices them with the same
+# layer_forward_costs table the tp auto-planner uses, and the param
+# subtree of block "a/b" is params["a"]["b"] (one source of truth for
+# splitting state by stage).
+PP_BLOCKS = (
+    "features/conv0",     # conv + relu
+    "features/conv1",     # conv + relu + maxpool
+    "features/conv2",     # conv + relu
+    "features/conv3",     # conv + relu + maxpool + NHWC flatten
+    "classifier/linear0",  # linear + relu + dropout(train)
+    "classifier/linear1",  # linear + float32 logits cast
+)
+
+# Blocks whose OUTPUT activation is model-sharded under the TP recipe
+# (column layers): a pipeline cut after one would hand a sharded
+# activation across stages, so the pp planner rejects those cut points
+# when m > 1 (parallel/pp/partition.py).
+PP_SHARDED_OUT = tuple(p for p, s in TP_RECIPE.items() if s == "column")
+
 Params = Dict[str, Any]
 
 
@@ -106,7 +129,34 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
     ``"replicated"`` — run the plain unsharded ops even under ``tp_axis``
     (their params are replicated over ``model``, and every model shard on
     one data row computes the same activations from the same rng)."""
+    return apply_blocks(params, batch_stats, x, blocks=(0, len(PP_BLOCKS)),
+                        train=train, rng=rng, compute_dtype=compute_dtype,
+                        tp_axis=tp_axis, tp_recipe=tp_recipe)
+
+
+def apply_blocks(params: Params, batch_stats: Dict, x: jax.Array, *,
+                 blocks: Tuple[int, int], train: bool,
+                 rng: Optional[jax.Array] = None,
+                 compute_dtype: Optional[jnp.dtype] = None,
+                 tp_axis: Optional[str] = None,
+                 tp_recipe: Optional[Dict[str, str]] = None,
+                 ) -> Tuple[jax.Array, Dict]:
+    """Run the contiguous PP_BLOCKS half-open range ``blocks=(lo, hi)`` —
+    the pipeline-parallel per-stage forward (parallel/pp/schedule.py).
+    ``x`` is the network input for ``lo == 0``, otherwise the activation
+    handed over from the previous stage.  ``(0, len(PP_BLOCKS))`` IS the
+    whole model: :func:`apply` delegates here, so the staged and unstaged
+    paths cannot drift (and s=1 stays bit-identical by construction).
+
+    ``params`` may be the full tree or any subtree that still contains
+    the blocks in range (the pp planner hands each stage only its own
+    leaves)."""
     del batch_stats
+    lo, hi = blocks
+    if not 0 <= lo < hi <= len(PP_BLOCKS):
+        raise ValueError(
+            f"blocks must be a non-empty range within "
+            f"(0, {len(PP_BLOCKS)}), got {blocks!r}")
     recipe = TP_RECIPE if tp_recipe is None else tp_recipe
     if tp_axis is not None:
         from ..parallel.tp.layers import (column_conv2d, column_linear,
@@ -118,11 +168,8 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
         return recipe.get(path, "replicated")
     cd = compute_dtype or x.dtype
     x = x.astype(cd)
-    idx = 0
-    for spec in _FEATURES:
-        if spec == "M":
-            x = max_pool(x, 2, 2)
-            continue
+
+    def conv_block(x, idx, pool):
         conv = params["features"][f"conv{idx}"]
         k, b = conv["kernel"].astype(cd), conv["bias"].astype(cd)
         s = style(f"features/conv{idx}")
@@ -133,36 +180,50 @@ def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
         else:
             x = conv2d(x, k, b, stride=1, padding=1)
         x = jax.nn.relu(x)
-        idx += 1
-    x = x.reshape(x.shape[0], -1)  # [N,8,8,32] -> [N,2048] (NHWC order)
-    cls = params["classifier"]
-    w0, b0 = (cls["linear0"]["weight"].astype(cd),
-              cls["linear0"]["bias"].astype(cd))
-    s0 = style("classifier/linear0")
-    if s0 == "column":
-        x = column_linear(x, w0, b0, tp_axis)
-    elif s0 == "row":
-        x = row_linear(x, w0, b0, tp_axis)
-    else:
-        x = linear(x, w0, b0)
-    x = jax.nn.relu(x)
-    if train:
-        if rng is None:
-            raise ValueError("DeepNN needs an rng for dropout in train mode")
-        # The mask is always drawn at FULL width; the sharded form only
-        # exists to slice it when the activation is linear0's column shard.
-        if s0 == "column":
-            x = sharded_dropout(rng, x, DROPOUT_RATE, train=True,
-                                axis_name=tp_axis)
-        else:
-            x = dropout(rng, x, DROPOUT_RATE, train=True)
-    w1, b1 = (cls["linear1"]["weight"].astype(cd),
-              cls["linear1"]["bias"].astype(cd))
-    s1 = style("classifier/linear1")
-    if s1 == "row":
-        logits = row_linear(x, w1, b1, tp_axis)
-    elif s1 == "column":
-        logits = column_linear(x, w1, b1, tp_axis)
-    else:
-        logits = linear(x, w1, b1)
-    return logits.astype(jnp.float32), {}
+        return max_pool(x, 2, 2) if pool else x
+
+    for name in PP_BLOCKS[lo:hi]:
+        if name == "features/conv0":
+            x = conv_block(x, 0, pool=False)
+        elif name == "features/conv1":
+            x = conv_block(x, 1, pool=True)
+        elif name == "features/conv2":
+            x = conv_block(x, 2, pool=False)
+        elif name == "features/conv3":
+            x = conv_block(x, 3, pool=True)
+            x = x.reshape(x.shape[0], -1)  # [N,8,8,32] -> [N,2048] (NHWC)
+        elif name == "classifier/linear0":
+            l0 = params["classifier"]["linear0"]
+            w0, b0 = l0["weight"].astype(cd), l0["bias"].astype(cd)
+            s0 = style("classifier/linear0")
+            if s0 == "column":
+                x = column_linear(x, w0, b0, tp_axis)
+            elif s0 == "row":
+                x = row_linear(x, w0, b0, tp_axis)
+            else:
+                x = linear(x, w0, b0)
+            x = jax.nn.relu(x)
+            if train:
+                if rng is None:
+                    raise ValueError(
+                        "DeepNN needs an rng for dropout in train mode")
+                # The mask is always drawn at FULL width; the sharded form
+                # only exists to slice it when the activation is linear0's
+                # column shard.
+                if s0 == "column":
+                    x = sharded_dropout(rng, x, DROPOUT_RATE, train=True,
+                                        axis_name=tp_axis)
+                else:
+                    x = dropout(rng, x, DROPOUT_RATE, train=True)
+        elif name == "classifier/linear1":
+            l1 = params["classifier"]["linear1"]
+            w1, b1 = l1["weight"].astype(cd), l1["bias"].astype(cd)
+            s1 = style("classifier/linear1")
+            if s1 == "row":
+                x = row_linear(x, w1, b1, tp_axis)
+            elif s1 == "column":
+                x = column_linear(x, w1, b1, tp_axis)
+            else:
+                x = linear(x, w1, b1)
+            x = x.astype(jnp.float32)
+    return x, {}

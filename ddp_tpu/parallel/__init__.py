@@ -1,11 +1,11 @@
-from .mesh import (DATA_AXIS, MODEL_AXIS, batch_sharding, data_axis_size,
-                   local_batch_slice, make_mesh, model_axis_size,
-                   replicated_sharding)
+from .mesh import (DATA_AXIS, MODEL_AXIS, STAGE_AXIS, batch_sharding,
+                   data_axis_size, local_batch_slice, make_mesh,
+                   model_axis_size, replicated_sharding, stage_axis_size)
 from .dist import initialize, process_count, process_index, shutdown
 
 __all__ = [
-    "DATA_AXIS", "MODEL_AXIS", "batch_sharding", "data_axis_size",
-    "local_batch_slice", "make_mesh", "model_axis_size",
-    "replicated_sharding", "initialize", "process_count", "process_index",
-    "shutdown",
+    "DATA_AXIS", "MODEL_AXIS", "STAGE_AXIS", "batch_sharding",
+    "data_axis_size", "local_batch_slice", "make_mesh", "model_axis_size",
+    "replicated_sharding", "stage_axis_size", "initialize", "process_count",
+    "process_index", "shutdown",
 ]

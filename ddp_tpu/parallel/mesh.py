@@ -28,13 +28,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+STAGE_AXIS = "stage"
+
+_AXIS_ORDER = (DATA_AXIS, MODEL_AXIS, STAGE_AXIS)
+
+
+def _check_shape(shape) -> Tuple[int, ...]:
+    """Validate a requested (d[, m[, s]]) mesh shape; returns it as ints.
+
+    The three named axes, in fixed order, are ``data`` (batch shards),
+    ``model`` (tensor-parallel) and ``stage`` (pipeline-parallel); errors
+    name all three so a malformed ``--mesh_shape`` points straight at the
+    contract rather than at an unpacking traceback."""
+    dims = tuple(shape)
+    if not 1 <= len(dims) <= 3:
+        raise ValueError(
+            f"mesh shape wants 1-3 axes (data[, model[, stage]]), got "
+            f"{len(dims)} entries: {shape!r}")
+    try:
+        dims = tuple(int(v) for v in dims)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh shape entries must be integers "
+            f"(data[, model[, stage]]), got {shape!r}") from None
+    if any(v < 1 for v in dims):
+        raise ValueError(
+            f"mesh shape axes (data, model, stage) must all be positive, "
+            f"got {shape!r}")
+    return dims
 
 
 def make_mesh(num_devices: Optional[int] = None,
               devices: Optional[list] = None,
-              shape: Optional[Tuple[int, int]] = None) -> Mesh:
-    """Device mesh: 1-D data-parallel by default, 2-D (data × model) on
-    request.
+              shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Device mesh: 1-D data-parallel by default, 2-D (data × model) or
+    3-D (data × model × stage) on request.
 
     ``make_mesh(1)`` is the singlegpu.py path, ``make_mesh()`` the
     multigpu.py path — the reference's one structural diff (SURVEY.md §1)
@@ -42,21 +70,29 @@ def make_mesh(num_devices: Optional[int] = None,
     tensor-parallel 2-D mesh with named ``(data, model)`` axes over the
     first ``d*m`` devices; ``shape=(d, 1)`` is a genuine 2-D mesh (the
     tp code paths run, trivially) — the 1-D default is untouched.
+    ``shape=(d, m, s)`` with s>1 grows the third ``stage`` axis for
+    pipeline parallelism (parallel/pp/); ``(d, m, 1)`` collapses to the
+    identical 2-D mesh so a trailing-1 stage axis is bit-compatible with
+    the tp path by construction.
     """
     if devices is None:
         devices = jax.devices()
     if shape is not None:
         if num_devices is not None:
             raise ValueError("pass num_devices or shape, not both")
-        d, m = int(shape[0]), int(shape[1])
-        if d < 1 or m < 1:
-            raise ValueError(f"mesh shape must be positive, got {shape}")
-        if d * m > len(devices):
+        dims = _check_shape(shape)
+        if len(dims) == 1:
+            return make_mesh(num_devices=dims[0], devices=devices)
+        if len(dims) == 3 and dims[2] == 1:
+            dims = dims[:2]  # (d, m, 1) IS the 2-D mesh — bit-compat anchor
+        n = int(np.prod(dims))
+        if n > len(devices):
             raise ValueError(
-                f"mesh shape {d}x{m} needs {d * m} devices, have "
-                f"{len(devices)}")
-        return Mesh(np.asarray(devices[:d * m]).reshape(d, m),
-                    (DATA_AXIS, MODEL_AXIS))
+                f"mesh shape {'x'.join(map(str, dims))} "
+                f"(data x model{' x stage' if len(dims) == 3 else ''}) "
+                f"needs {n} devices, have {len(devices)}")
+        return Mesh(np.asarray(devices[:n]).reshape(dims),
+                    _AXIS_ORDER[:len(dims)])
     if num_devices is not None:
         if num_devices > len(devices):
             raise ValueError(
@@ -65,16 +101,19 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(np.asarray(devices), (DATA_AXIS,))
 
 
-def abstract_mesh(shape: Tuple[int, int]):
-    """A deviceless 2-D ``(data, model)`` AbstractMesh — the auto-plan
+def abstract_mesh(shape: Tuple[int, ...]):
+    """A deviceless ``(data, model[, stage])`` AbstractMesh — the auto-plan
     search's substrate (parallel/tp/autoplan.py): ``jax.make_jaxpr`` traces
     the REAL step builders against it for ANY mesh shape, so a laptop/CI
     CPU box can price v4-128 layouts without owning a single chip.  Only
     tracing works on it — no ``device_put``, no execution."""
-    d, m = int(shape[0]), int(shape[1])
-    if d < 1 or m < 1:
-        raise ValueError(f"mesh shape must be positive, got {shape}")
-    return jax.sharding.AbstractMesh(((DATA_AXIS, d), (MODEL_AXIS, m)))
+    dims = _check_shape(shape)
+    if len(dims) == 1:
+        dims = (dims[0], 1)
+    if len(dims) == 3 and dims[2] == 1:
+        dims = dims[:2]
+    return jax.sharding.AbstractMesh(
+        tuple(zip(_AXIS_ORDER[:len(dims)], dims)))
 
 
 def mesh_size(mesh) -> int:
@@ -95,6 +134,11 @@ def data_axis_size(mesh: Mesh) -> int:
 def model_axis_size(mesh: Mesh) -> int:
     """Model-axis extent (1 on the default 1-D mesh)."""
     return int(dict(mesh.shape).get(MODEL_AXIS, 1))
+
+
+def stage_axis_size(mesh: Mesh) -> int:
+    """Stage-axis extent (1 on 1-D/2-D meshes — no pipeline)."""
+    return int(dict(mesh.shape).get(STAGE_AXIS, 1))
 
 
 _SCAN_UNROLL_CAP = 32

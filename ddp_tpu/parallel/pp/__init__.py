@@ -1,0 +1,19 @@
+"""Pipeline parallelism over the third ``stage`` mesh axis.
+
+``partition.py`` cuts the model's declared PP_BLOCKS into balanced
+contiguous stages with the auto-plan cost model's per-layer flop table;
+``schedule.py`` runs GPipe / 1F1B microbatch schedules as per-stage jitted
+programs over the (data × model) submesh of each stage, handing
+activations across stages with explicit device transfers.  ``python -m
+ddp_tpu.parallel.pp`` prints the offline stage table.
+"""
+from .partition import (StagePlan, format_stage_table, plan_stages,
+                        predicted_bubble, stage_model_psums,
+                        stage_param_paths, stage_subtree)
+from .schedule import make_pp_step, place_state, pp_shard_fn, stage_submesh
+
+__all__ = [
+    "StagePlan", "format_stage_table", "plan_stages", "predicted_bubble",
+    "stage_model_psums", "stage_param_paths", "stage_subtree",
+    "make_pp_step", "place_state", "pp_shard_fn", "stage_submesh",
+]

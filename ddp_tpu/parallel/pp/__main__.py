@@ -1,0 +1,52 @@
+"""``python -m ddp_tpu.parallel.pp`` — the offline stage table.
+
+The pipeline analogue of ``python -m ddp_tpu.parallel.tp``: resolve the
+model's PP_BLOCKS into a balanced ``--stages``-way cut (priced with the
+auto-plan cost model's per-layer forward flops), print the stage table the
+CLI prints at startup under a 3-D ``--mesh_shape``, and exit non-zero on
+an infeasible partition — so layouts can be sanity-checked without
+owning a single chip.  ``--model_size`` restricts the cut set exactly as
+the live (d, m, s) mesh would; ``--microbatches`` adds the
+predicted-bubble footer the bench compares measured fractions against.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+
+from .partition import format_stage_table, plan_stages
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ddp_tpu.parallel.pp",
+        description="pipeline stage partitioner (offline stage table)")
+    ap.add_argument("--model", default="deepnn")
+    ap.add_argument("--stages", type=int, default=2,
+                    help="stage count s (the mesh's third axis)")
+    ap.add_argument("--model_size", type=int, default=1,
+                    help="tensor-parallel m the stages compose with "
+                         "(restricts cut points to full-width boundaries)")
+    ap.add_argument("--microbatches", type=int, default=None,
+                    help="print the predicted bubble fraction at this "
+                         "microbatch count")
+    args = ap.parse_args(argv)
+
+    from ...models import get_model
+    try:
+        model = get_model(args.model)
+        params, batch_stats = model.init(jax.random.key(0))
+        plan = plan_stages(args.model, args.stages,
+                           model_size=args.model_size, params=params,
+                           batch_stats=batch_stats)
+    except ValueError as e:
+        print(e, file=sys.stderr)
+        return 1
+    print(format_stage_table(plan, num_micro=args.microbatches))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

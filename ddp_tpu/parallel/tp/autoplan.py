@@ -282,9 +282,10 @@ def validate_plan_doc(doc: dict) -> None:
     if not isinstance(doc.get("model"), str) or not doc.get("model"):
         errors.append("  model: expected a non-empty string")
     ms = doc.get("mesh_shape")
-    if (not isinstance(ms, list) or len(ms) != 2
+    if (not isinstance(ms, list) or len(ms) not in (2, 3)
             or not all(isinstance(v, int) and v >= 1 for v in ms)):
-        errors.append(f"  mesh_shape: expected [d, m] of positive ints, "
+        errors.append(f"  mesh_shape: expected [d, m] or [d, m, s] "
+                      f"(data, model, pipeline stage) of positive ints, "
                       f"got {ms!r}")
     recipe = doc.get("recipe")
     if not isinstance(recipe, dict):

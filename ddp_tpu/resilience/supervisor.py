@@ -17,10 +17,14 @@ operator should sit in:
 
 Elastic restarts: before each relaunch the supervisor probes the live
 device count and shrinks ``--mesh_shape`` to the largest surviving
-``(d, m)`` the checkpoint reshards onto (``load_for_mesh`` makes any
-shape restorable), then grows back to the full mesh at the next relaunch
-once devices return.  Growth only ever happens at a relaunch boundary —
-a running child's mesh is immutable.
+``(d, m)`` — or, for a pipelined ``(d, m, s)`` run, the largest
+``(d, m, s')`` with the STAGE axis giving way first, since the canonical
+checkpoint restores onto any stage count and the partitioner re-cuts the
+model into the surviving stages at relaunch — that the checkpoint
+reshards onto (``load_for_mesh`` makes any shape restorable), then grows
+back to the full mesh at the next relaunch once devices return.  Growth
+only ever happens at a relaunch boundary — a running child's mesh is
+immutable.
 
 The failure ledger tails the child's metrics JSONL between launches and
 keeps, per death, the exit code, the mesh it ran on, and the last
@@ -85,14 +89,33 @@ def backoff_delay(restart_no: int, *, base: float, cap: float,
     return max(0.0, nominal * spread)
 
 
-def shrink_mesh(full: Tuple[int, int], ndev: int) -> Tuple[int, int]:
-    """The largest surviving ``(d, m)`` under ``full = (D, M)`` that fits
-    on ``ndev`` devices.  The model axis is load-bearing (the checkpoint's
+def shrink_mesh(full: Tuple[int, ...], ndev: int) -> Tuple[int, ...]:
+    """The largest surviving mesh under ``full`` that fits on ``ndev``
+    devices; same arity out as in.
+
+    2-D ``(D, M)``: the model axis is load-bearing (the checkpoint's
     layer shards assume M-way TP unless resharded), so shrink the DATA
     axis first and only split M when even one M-wide replica no longer
-    fits — then the largest divisor of M that does."""
-    d, m = int(full[0]), int(full[1])
+    fits — then the largest divisor of M that does.
+
+    3-D ``(D, M, S)``: the STAGE axis shrinks first — losing a host
+    kills a whole stage plane, the canonical checkpoint restores onto
+    any stage count, and the partitioner simply re-cuts the model into
+    the surviving ``s'`` stages at relaunch (``s'=1`` collapses to the
+    plain 2-D mesh), so stages are the cheapest axis to give up.  Only
+    when not even one (D, M) plane survives does the 2-D policy above
+    take over (with s=1)."""
+    dims = tuple(int(v) for v in full)
     ndev = max(1, int(ndev))
+    if len(dims) == 3:
+        d, m, s = dims
+        if d * m * s <= ndev:
+            return (d, m, s)
+        if d * m <= ndev:
+            return (d, m, max(1, ndev // (d * m)))
+        d2, m2 = shrink_mesh((d, m), ndev)
+        return (d2, m2, 1)
+    d, m = dims
     if d * m <= ndev:
         return (d, m)
     if m <= ndev:
@@ -374,11 +397,12 @@ class Supervisor:
         # Full-mesh topology to grow back to, parsed once from the ORIGINAL
         # argv (later relaunches rewrite the flags in place).
         mesh = _get_flag(self.child_argv, "--mesh_shape")
-        self._full_mesh: Optional[Tuple[int, int]] = None
+        self._full_mesh: Optional[Tuple[int, ...]] = None
         if mesh:
             try:
-                d, m = (int(x) for x in mesh.split(","))
-                self._full_mesh = (d, m)
+                dims = tuple(int(x) for x in mesh.split(","))
+                if len(dims) in (2, 3):
+                    self._full_mesh = dims
             except ValueError:
                 pass
         ndev = _get_flag(self.child_argv, "--num_devices")
@@ -421,14 +445,23 @@ class Supervisor:
             return argv  # no topology flags to manage
         ndev = self._device_probe(self._child_env(first_launch=False))
         if self._full_mesh is not None:
-            full_n = self._full_mesh[0] * self._full_mesh[1]
-            d, m = shrink_mesh(self._full_mesh,
-                               full_n if ndev is None else ndev)
-            if (d, m) != self._full_mesh:
+            full_n = 1
+            for v in self._full_mesh:
+                full_n *= v
+            new = shrink_mesh(self._full_mesh,
+                              full_n if ndev is None else ndev)
+            if new != self._full_mesh:
+                note = ""
+                if len(new) == 3 and new[2] != self._full_mesh[2]:
+                    note = (f" (stage plane lost: the partitioner re-cuts "
+                            f"{self._full_mesh[2]} -> {new[2]} stage(s) "
+                            f"from the canonical checkpoint)")
                 print(f"[supervise] {ndev} device(s) live: shrinking mesh "
-                      f"{self._full_mesh[0]},{self._full_mesh[1]} -> "
-                      f"{d},{m} for this relaunch", file=sys.stderr)
-            argv = _set_flag(argv, "--mesh_shape", f"{d},{m}")
+                      f"{','.join(map(str, self._full_mesh))} -> "
+                      f"{','.join(map(str, new))} for this relaunch{note}",
+                      file=sys.stderr)
+            argv = _set_flag(argv, "--mesh_shape",
+                             ",".join(map(str, new)))
         else:
             want = self._full_num_devices
             n = want if ndev is None else min(want, ndev)

@@ -96,6 +96,8 @@ class Trainer:
                  tracer=None,
                  live=None,
                  tp_plan=None,
+                 pp_plan=None,
+                 pp_schedule: str = "1f1b",
                  ckpt_format: str = "gathered",
                  drift_audit_every: int = 0,
                  drift_action: str = "abort",
@@ -181,6 +183,29 @@ class Trainer:
                 f"{ckpt_format!r}")
         self.ckpt_format = ckpt_format
         self.tp_plan = tp_plan
+        # Pipeline parallelism (parallel/pp/): a StagePlan over the mesh's
+        # third ``stage`` axis.  Checked before the restore below because
+        # the checkpoint loader's placement policy depends on it.
+        self.pp_plan = pp_plan
+        self.pp_schedule = pp_schedule
+        if pp_plan is not None:
+            incompatible = [flag for flag, on in (
+                ("--resident (per-stage programs dispatch per step)",
+                 resident),
+                ("--shard_update (ZeRO shards momentum over data; pp "
+                 "shards it over stages)", shard_update),
+                ("--sync_bn (stage programs do not exchange batch stats)",
+                 sync_bn),
+                ("--drift_audit_every (params are stage-partitioned, not "
+                 "replicated over data)", bool(drift_audit_every)),
+                ("--ckpt_format sharded (pipeline checkpoints stay "
+                 "canonical/gathered so any (d,m,s) restores anywhere)",
+                 ckpt_format == "sharded"),
+            ) if on]
+            if incompatible:
+                raise ValueError(
+                    "pipeline parallelism (stage axis s>1) is incompatible "
+                    "with:\n" + "\n".join(f"  - {f}" for f in incompatible))
         self.start_epoch = 0
         self.state = init_train_state(params, batch_stats)
         if resume and snapshot_path:
@@ -254,10 +279,19 @@ class Trainer:
         # gathered file stays canonical and a sharded set redistributes,
         # so restore re-shards onto whatever mesh this run has (for a
         # loader-restored state this device_put is already a no-op).
-        if tp_plan is not None:
+        if tp_plan is not None and pp_plan is None:
             from ..parallel.tp.plan import state_shardings
             self.state = jax.device_put(self.state,
                                         state_shardings(tp_plan, mesh))
+        elif pp_plan is not None:
+            # Stage placement (parallel/pp/schedule.py): each stage's
+            # param/momentum subtrees land on that stage's (data x model)
+            # submesh — tp-sharded within the stage when a plan composes.
+            # Same portability contract as the tp re-shard above: restore
+            # loads host/replicated, placement happens here, so any
+            # checkpoint restores onto any (d, m, s).
+            from ..parallel.pp.schedule import place_state
+            self.state = place_state(self.state, mesh, pp_plan, tp_plan)
         # Streaming overlap engine knobs (data/prefetch.py): how many
         # batches may be in flight beyond the worker pool's hands, and how
         # many materialise/augment workers run.  depth=0 disables the
@@ -332,6 +366,24 @@ class Trainer:
                 (shard_update, self.grad_accum > 1)]
             self.train_epoch = build(model, sgd_config, lr_schedule, mesh,
                                      **kw)
+        elif pp_plan is not None:
+            # Pipeline path: per-stage jitted programs driven by a host
+            # schedule (parallel/pp/schedule.py).  Wrapped to the shared
+            # builder signature so _rebuild_step (the guard's lr_backoff
+            # recompile hook) works unchanged.
+            from ..parallel.pp.schedule import make_pp_step
+
+            def build(model, sgd_config, sched, mesh, *, compute_dtype=None,
+                      device_augment=False, sync_bn=False, plan=None):
+                del sync_bn  # rejected above; signature parity only
+                return make_pp_step(model.name, sgd_config, sched, mesh,
+                                    pp_plan, compute_dtype=compute_dtype,
+                                    device_augment=device_augment,
+                                    tp_plan=plan, schedule=pp_schedule,
+                                    tracer=self.tracer)
+
+            self.train_step = build(model, sgd_config, lr_schedule, mesh,
+                                    **kw)
         else:
             from .step import make_train_step_accum
             from .zero import make_train_step_zero, make_train_step_zero_accum
@@ -385,7 +437,11 @@ class Trainer:
         import functools
 
         from .ckpt_shard import load_for_mesh
-        specs = (self.tp_plan.param_specs if self.tp_plan is not None
+        # Under a pipeline plan the loader restores replicated (specs
+        # None): __init__'s place_state pass owns the stage layout, so the
+        # file's mesh shape never has to match this run's (d, m, s).
+        specs = (self.tp_plan.param_specs
+                 if self.tp_plan is not None and self.pp_plan is None
                  else None)
         return functools.partial(load_for_mesh, mesh=self.mesh,
                                  param_specs=specs)
@@ -409,7 +465,7 @@ class Trainer:
         ``start`` without materialising the skipped prefix."""
         epoch_losses = []
         from ..data.prefetch import prefetch_to_device
-        if self.grad_accum > 1:
+        if self.grad_accum > 1 or self.pp_plan is not None:
             # One dispatch per GROUP of grad_accum micro-batches.  The
             # scanned accumulation amortises the per-dispatch overhead A-x;
             # the threaded prefetcher still pipelines group materialisation
@@ -418,11 +474,20 @@ class Trainer:
             # the single-thread path; the stacked sharding rides in via
             # shard_fn.
             from .step import shard_batch_stacked
+            if self.pp_plan is not None:
+                # Pipeline microbatch injection: the SAME stacked group
+                # stream, but images land on stage 0's submesh and labels
+                # on the last stage's (parallel/pp/schedule.py) — the
+                # schedule slices microbatch k out of the [A, ...] stack.
+                from ..parallel.pp.schedule import pp_shard_fn
+                stacked_shard = pp_shard_fn(self.pp_plan)
+            else:
+                stacked_shard = shard_batch_stacked
             batches = prefetch_to_device(
                 _stack_groups(self.train_loader, self.grad_accum),
                 self.mesh, depth=self.prefetch_depth,
                 workers=self.prefetch_workers, stats=self.prefetch_stats,
-                shard_fn=shard_batch_stacked, tracer=self.tracer,
+                shard_fn=stacked_shard, tracer=self.tracer,
                 step0=self._host_step, start=start)
         else:
             # Worker pool augments + device_puts ahead of the loop (the
@@ -710,7 +775,18 @@ class Trainer:
         sharded = self.ckpt_format == "sharded"
         params, stats = self.state.params, self.state.batch_stats
         gathered = False
-        if self.tp_plan is not None and not sharded:
+        if self.pp_plan is not None:
+            # Pipeline state lives on per-stage SUBMESHES — one jitted
+            # identity cannot span the disjoint device sets, so the
+            # canonical/gathered file is assembled on the host instead
+            # (a D2H copy per leaf: fresh host buffers, donation-safe by
+            # construction, so the snapshot pass below is skipped too).
+            # Single-process only, like the stage schedule itself.
+            params, stats, mom = jax.device_get(
+                (params, stats, opt_state.momentum_buf))
+            opt_state = SGDState(mom)
+            gathered = True
+        elif self.tp_plan is not None and not sharded:
             rep = replicated_sharding(self.mesh)
             params, stats, mom = jax.jit(
                 lambda p, s, m: (p, s, m),
@@ -828,10 +904,14 @@ class Trainer:
             jax.tree_util.tree_map(jnp.asarray, ckpt.batch_stats),
             jax.tree_util.tree_map(jnp.asarray, ckpt.opt_state),
             jnp.asarray(ckpt.step, jnp.int32))
-        if self.tp_plan is not None:
+        if self.tp_plan is not None and self.pp_plan is None:
             from ..parallel.tp.plan import state_shardings
             state = jax.device_put(state,
                                    state_shardings(self.tp_plan, self.mesh))
+        elif self.pp_plan is not None:
+            from ..parallel.pp.schedule import place_state
+            state = place_state(state, self.mesh, self.pp_plan,
+                                self.tp_plan)
         if self.shard_update:
             from .zero import pytree_to_opt_shard
             state = TrainState(state.params, state.batch_stats,

@@ -27,8 +27,14 @@ claims to survive):
   local_wipe       preempt with ``--mirror`` on, then rm -rf the ENTIRE
                    local checkpoint directory -> supervised resume must
                    restore from the remote mirror tier alone
+  kill_stage       a (2,1,2) PIPELINED run is preempted mid-schedule and
+                   a whole stage plane stays dead at relaunch (the probe
+                   sees 2 devices) -> the supervisor's stage-first
+                   shrink re-cuts the pipeline to (2,1,1) and the
+                   canonical checkpoint restores onto the collapsed 2-D
+                   mesh bit-identically
 
-Three control configs: A (64-sample synthetic, 2 steps/epoch — fast)
+Four control configs: A (64-sample synthetic, 2 steps/epoch — fast)
 for most drills; B (320-sample, 10 steps/epoch, save_every=2) for
 ``poison_batch`` so the loss-health guard has its minimum 8-step
 history before the poisoned step AND no checkpoint lands between the
@@ -36,7 +42,11 @@ poison and the abort (epoch 1 never saves under save_every=2; the
 deferred loss flush kills the run at the top of epoch 2, before its
 save) — the relaunch therefore resumes from clean bytes; C (A minus
 ``--mesh_shape``) for ``flip_param_bit``, because the drift audit
-refuses the tensor-parallel plan that any ``--mesh_shape`` builds.
+refuses the tensor-parallel plan that any ``--mesh_shape`` builds; P
+(32-sample, ``--mesh_shape 2,1,2 --grad_accum 2``, 2 optimizer
+steps/epoch) for ``kill_stage`` — the pipelined config whose staged
+step is bit-compatible with the plain (2,1) grad-accum step it
+collapses onto after the shrink.
 
 CI runs the ``sigterm_step,watchdog_stall`` subset as the supervisor
 smoke (``bench.py --chaos`` is the porcelain); the full matrix is the
@@ -81,6 +91,12 @@ _CONFIGS = {
     "C": ["3", "1", "--batch_size", "4", "--synthetic", "--model",
           "deepnn", "--lr", "0.05", "--synthetic_size", "64",
           "--seed", "3"],
+    # Config P: the pipelined drill mesh — 2 data replicas x 2 stages on
+    # 4 of the virtual devices, grad_accum=2 so the 1F1B schedule has
+    # micro-batches to overlap, 2 optimizer steps/epoch (32/(4*2*2)).
+    "P": ["3", "1", "--batch_size", "4", "--synthetic", "--model",
+          "deepnn", "--lr", "0.05", "--synthetic_size", "32",
+          "--seed", "3", "--grad_accum", "2", "--mesh_shape", "2,1,2"],
 }
 
 # name -> (config, DDP_TPU_FAULT spec or None for two-stage, extra argv)
@@ -96,6 +112,7 @@ _DRILLS = {
                       "--guard_action", "abort"]),
     "torn_data_state": ("A", None, []),  # two-stage, see _run_torn
     "local_wipe": ("A", None, []),       # two-stage, see _run_local_wipe
+    "kill_stage": ("P", None, []),       # custom probe, see _run_kill_stage
 }
 
 
@@ -291,6 +308,31 @@ def _run_local_wipe(root: str, env: dict, timeout: float) -> dict:
             "wall_s": round(wall1 + wall2, 1)}
 
 
+def _run_kill_stage(root: str, env: dict, timeout: float) -> dict:
+    """Stage-loss drill: the (2,1,2) pipelined run is SIGTERMed
+    mid-schedule (exit 75, emergency checkpoint on disk), and when the
+    supervisor relaunches, its device probe reports only 2 live devices
+    — one whole stage plane gone for good.  The stage-first shrink
+    policy must give up the stage axis ((2,1,2) -> (2,1,1), which the
+    mesh layer collapses to the plain 2-D (2,1)) rather than halving the
+    data axis, and the canonical checkpoint must restore onto the re-cut
+    mesh and finish BIT-IDENTICAL to the undisturbed (2,1,2) control —
+    the (d,m,s) == (d,m,1) parity the pp test suite pins, exercised here
+    across a real kill/restart boundary."""
+    workdir = os.path.join(root, "kill_stage")
+    os.makedirs(workdir, exist_ok=True)
+    child = _child_argv("P", [], workdir)
+    drill_env = dict(env)
+    # The probe seam: XLA still carves the full virtual-device set, but
+    # the supervisor believes only one (d, m) plane survived.
+    drill_env["DDP_TPU_SUPERVISE_DEVICES"] = "2"
+    rc, wall = _supervised(child, drill_env, timeout, "kill_stage",
+                           fault="sigterm@step=2")
+    return {"workdir": workdir, "supervisor_exit": rc,
+            "fault": "sigterm@step=2 + stage plane dead at relaunch",
+            "wall_s": round(wall, 1)}
+
+
 def run_campaign(drills: List[str], root: str, env: dict,
                  timeout: float) -> dict:
     configs = sorted({_DRILLS[d][0] for d in drills})
@@ -302,6 +344,8 @@ def run_campaign(drills: List[str], root: str, env: dict,
             res = _run_torn(root, env, timeout)
         elif name == "local_wipe":
             res = _run_local_wipe(root, env, timeout)
+        elif name == "kill_stage":
+            res = _run_kill_stage(root, env, timeout)
         else:
             workdir = os.path.join(root, name)
             os.makedirs(workdir, exist_ok=True)
