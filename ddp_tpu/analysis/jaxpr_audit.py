@@ -157,15 +157,18 @@ def audit_collectives(name: str, kind: str, inv: Dict,
                 "TP layer's collective, or a reduction landed on the "
                 "wrong axis")
     elif plan is not None:
-        from ..parallel.tp.plan import expected_collectives
-        exp = expected_collectives(plan, backward=(kind == "update"))
+        from ..parallel.tp.plan import (expected_collectives,
+                                        format_collective_table)
+        backward = kind == "update"
+        exp = expected_collectives(plan, backward=backward)
         if model_psums != exp["psum_model"]:
             err("collective-count",
                 f"psum over '{MODEL_AXIS}' x{model_psums}, plan expects "
                 f"x{exp['psum_model']} (fwd {exp['psum_model_fwd']} + bwd "
                 f"{exp['psum_model_bwd']}) — a TP layer collective is "
                 "missing or duplicated, or a gradient reduction landed on "
-                "the wrong axis")
+                "the wrong axis; the plan's per-layer unit table:\n"
+                + format_collective_table(plan, backward=backward))
     elif model_psums:
         err("collective-axis",
             f"psum over '{MODEL_AXIS}' x{model_psums} in a program with "

@@ -43,6 +43,9 @@ DEFAULT_MODEL = "deepnn"
 DEFAULT_MESH_2D = (2, 4)
 _BATCH = 32      # global rows per step for the audit trace
 _ACCUM = 2       # micro-batches for the accum variants
+_LM_T = 32       # sequence length the LM train-step audit traces
+_LM_SLOTS = 8    # KV-cache slots the decode audit traces
+_LM_BUCKET = 16  # padded prompt bucket the prefill audit traces
 
 
 class BuiltProgram(NamedTuple):
@@ -64,6 +67,11 @@ class ProgramSpec(NamedTuple):
     zero: bool
     tp: bool
     build: Callable[["_Ctx", str], BuiltProgram]
+    # Which workload family the entry belongs to: "image" (the CIFAR
+    # classifier programs), "lm" (the tinylm decoder: LM train step +
+    # the KV-cache serving programs), or None (workload-agnostic, e.g.
+    # the drift audit — a params fingerprint prices identically).
+    workload: Optional[str] = "image"
 
 
 class _Ctx(NamedTuple):
@@ -81,6 +89,7 @@ class _Ctx(NamedTuple):
     model_name: str = DEFAULT_MODEL
     mesh3d: Any = None
     pp_plan: Optional[Any] = None
+    workload: str = "image"
 
 
 def _sds(tree):
@@ -299,9 +308,83 @@ def _pp_programs(ctx: _Ctx) -> List[BuiltProgram]:
     return out
 
 
+def _lm_module():
+    from ..models import transformer as tfm
+    return tfm
+
+
+def _lm_cache_sds(slots: int):
+    tfm = _lm_module()
+    return jax.ShapeDtypeStruct(
+        (int(tfm.N_LAYERS), slots, int(tfm.T_MAX), int(tfm.N_HEADS),
+         int(tfm.HEAD_DIM)), jnp.float32)
+
+
+def _build_lm_step(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
+    """The LM optimizer step (train/lm.py) — same invariants as the
+    classifier update: psum-over-data on grads, full state donation,
+    exactly the plan's model-psum count under TP."""
+    from ..train.lm import make_lm_train_step
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    cfg, sched = _sgd()
+    fn = make_lm_train_step(ctx.model, cfg, sched, mesh, plan=plan)
+    state = _train_state(ctx, mesh, zero=False, plan=plan)
+    tokens = jax.ShapeDtypeStruct((_BATCH, _LM_T), jnp.int32)
+    return BuiltProgram(name, "update", False, fn,
+                        (state, tokens, _rng()), plan)
+
+
+def _build_lm_prefill(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
+    """The serve prompt prefill (serve/kvcache.py): forward-kind — no
+    data collectives ever; exactly the plan's forward model psums under
+    TP (attention heads sharded, same rows as the train forward)."""
+    from ..serve.kvcache import make_lm_prefill
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    fn = make_lm_prefill(_lm_module(), mesh, plan=plan)
+    tokens = jax.ShapeDtypeStruct((_LM_BUCKET,), jnp.int32)
+    return BuiltProgram(name, "forward", False, fn,
+                        (_sds(ctx.params), tokens), plan)
+
+
+def _build_lm_decode(ctx: _Ctx, name: str, *, tp: bool) -> BuiltProgram:
+    """The single-token decode step over the slot-sharded KV cache —
+    the ONE executable a serving run decodes every token with."""
+    from ..serve.kvcache import make_lm_decode
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    plan = ctx.plan if tp else None
+    fn = make_lm_decode(_lm_module(), mesh, plan=plan)
+    vec = jax.ShapeDtypeStruct((_LM_SLOTS,), jnp.int32)
+    cache = _lm_cache_sds(_LM_SLOTS)
+    return BuiltProgram(name, "forward", False, fn,
+                        (_sds(ctx.params), vec, vec, cache, cache), plan)
+
+
+def _build_lm_cache_write(ctx: _Ctx, name: str, *, tp: bool
+                          ) -> BuiltProgram:
+    """The KV-cache slot scatter: pure ownership arithmetic, audited
+    COLLECTIVE-FREE (the BuiltProgram carries no plan even under TP, so
+    any psum — model or data — fails the audit)."""
+    from ..serve.kvcache import make_cache_write
+    tfm = _lm_module()
+    mesh = ctx.mesh2d if tp else ctx.mesh1d
+    fn = make_cache_write(mesh, ctx.plan if tp else None)
+    cache = _lm_cache_sds(_LM_SLOTS)
+    kv_new = jax.ShapeDtypeStruct(
+        (int(tfm.N_LAYERS), _LM_BUCKET, int(tfm.N_HEADS),
+         int(tfm.HEAD_DIM)), jnp.float32)
+    slot = jax.ShapeDtypeStruct((), jnp.int32)
+    return BuiltProgram(name, "forward", False, fn,
+                        (cache, cache, kv_new, kv_new, slot), None)
+
+
 def _spec(name, kind, *, zero=False, tp=False, accum=False,
-          auto=False) -> ProgramSpec:
-    if auto:
+          auto=False, workload: Optional[str] = "image",
+          builder=None) -> ProgramSpec:
+    if builder is not None:
+        build = functools.partial(builder, tp=tp)
+    elif auto:
         build = _build_auto
     elif kind == "update":
         build = functools.partial(_build_step, accum=accum, zero=zero,
@@ -312,7 +395,7 @@ def _spec(name, kind, *, zero=False, tp=False, accum=False,
         build = _build_drift
     else:
         build = functools.partial(_build_forward, tp=tp)
-    return ProgramSpec(name, kind, zero, tp, build)
+    return ProgramSpec(name, kind, zero, tp, build, workload)
 
 
 # The default registry — all of it traces in seconds; names are stable
@@ -333,12 +416,37 @@ REGISTRY: Tuple[ProgramSpec, ...] = (
     _spec("eval_step@tp", "eval", tp=True),
     _spec("serve_forward@dp8", "forward"),
     _spec("serve_forward@tp", "forward", tp=True),
-    _spec("drift_audit@dp8", "audit"),
+    _spec("drift_audit@dp8", "audit", workload=None),
+    # The tinylm decoder workload (--model tinylm): the LM train step
+    # plus the generative serving programs (serve/kvcache.py), priced
+    # and audited like every other entry.
+    _spec("lm_train_step@dp8", "update", workload="lm",
+          builder=_build_lm_step),
+    _spec("lm_train_step@tp", "update", tp=True, workload="lm",
+          builder=_build_lm_step),
+    _spec("lm_prefill@dp8", "forward", workload="lm",
+          builder=_build_lm_prefill),
+    _spec("lm_prefill@tp", "forward", tp=True, workload="lm",
+          builder=_build_lm_prefill),
+    _spec("lm_decode@dp8", "forward", workload="lm",
+          builder=_build_lm_decode),
+    _spec("lm_decode@tp", "forward", tp=True, workload="lm",
+          builder=_build_lm_decode),
+    _spec("lm_cache_write@dp8", "forward", workload="lm",
+          builder=_build_lm_cache_write),
+    _spec("lm_cache_write@tp", "forward", tp=True, workload="lm",
+          builder=_build_lm_cache_write),
 )
 
 
-def program_names() -> List[str]:
-    return [s.name for s in REGISTRY]
+def program_names(workload: Optional[str] = None) -> List[str]:
+    """All registry names; with ``workload`` given, only the entries
+    that build for that workload (workload-``None`` specs — the
+    model-agnostic programs — always apply)."""
+    if workload is None:
+        return [s.name for s in REGISTRY]
+    return [s.name for s in REGISTRY
+            if s.workload is None or s.workload == workload]
 
 
 def build_context(model_name: str = DEFAULT_MODEL,
@@ -350,9 +458,11 @@ def build_context(model_name: str = DEFAULT_MODEL,
     plan, registering the staged pipeline programs (``pp_*@pp``) — the
     backend then needs d*m*s virtual devices."""
     from ..models import get_model
+    from ..models import transformer as tfm
     from ..parallel.mesh import make_mesh
     d, m = int(mesh_2d[0]), int(mesh_2d[1])
     s = int(mesh_2d[2]) if len(mesh_2d) > 2 else 1
+    workload = "lm" if model_name == tfm.LM_NAME else "image"
     model = get_model(model_name)
     params, stats = model.init(jax.random.key(0))
     mesh1d = make_mesh(d * m)
@@ -374,7 +484,7 @@ def build_context(model_name: str = DEFAULT_MODEL,
         except ValueError:
             pp_plan = None  # no PP_BLOCKS / infeasible cut: pp skipped
     return _Ctx(model, mesh1d, mesh2d, plan, params, stats, model_name,
-                mesh3d, pp_plan)
+                mesh3d, pp_plan, workload)
 
 
 def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
@@ -392,6 +502,8 @@ def build_programs(ctx: _Ctx, names=None) -> List[BuiltProgram]:
     out = []
     for spec in REGISTRY:
         if wanted is not None and spec.name not in wanted:
+            continue
+        if spec.workload is not None and spec.workload != ctx.workload:
             continue
         if spec.tp and ctx.plan is None:
             continue

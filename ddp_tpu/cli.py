@@ -143,15 +143,16 @@ def build_parser(description: str) -> argparse.ArgumentParser:
                         "directory (rank 0; needs tensorflow)")
     # Observability surface (ddp_tpu/obs/): always-on span tracing with a
     # kill-switch, plus the rolling live-stats cadence.
-    p.add_argument("--trace_spill", default="trace_spill.jsonl",
+    p.add_argument("--trace_spill", default=None,
                    metavar="PATH",
                    help="Span-tracer spill file (obs/tracer.py): one JSON "
                         "line per completed phase span (data_wait/"
                         "host_augment/h2d/dispatch/loss_flush/ckpt_write/"
                         "eval); analyze or export to Perfetto with "
                         "python -m ddp_tpu.obs.  Multi-host ranks >0 "
-                        "append a .hostN suffix.  Default "
-                        "trace_spill.jsonl (same always-on overwrite "
+                        "append a .hostN suffix.  Default: "
+                        "trace_spill.jsonl NEXT TO --snapshot_path (the "
+                        "run's output dir, same always-on overwrite "
                         "discipline as checkpoint.pt); '' keeps the "
                         "in-memory tracer (watchdog/straggler telemetry) "
                         "without a spill file")
@@ -781,14 +782,21 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
     # a closed spill handle.  --obs_off keeps the NullTracer: hot paths
     # then cost two trivial method calls per span (the zero-overhead
     # kill-switch contract).
-    from .obs.tracer import NullTracer, SpanTracer, set_tracer
+    from .obs.tracer import (NullTracer, SpanTracer, default_spill_path,
+                             set_tracer)
+    # Unset --trace_spill defaults to the run's output dir (next to the
+    # checkpoint head), not the CWD; '' stays the explicit kill value.
+    trace_spill = args.trace_spill
+    if trace_spill is None:
+        trace_spill = default_spill_path(args.snapshot_path,
+                                         "trace_spill.jsonl")
     if args.obs_off:
         tracer = NullTracer()
         # Remove a previous traced run's spill at this path: leaving it
         # would hand `python -m ddp_tpu.obs` a stale run's timeline with
         # nothing marking it as such (same overwrite-in-place discipline
         # as the traced branch, which truncates).
-        stale = args.trace_spill or None
+        stale = trace_spill or None
         if stale and jax.process_index() > 0:
             stale = f"{stale}.host{jax.process_index()}"
         if stale:
@@ -796,7 +804,7 @@ def _run_body(args: argparse.Namespace, *, num_devices: Optional[int]) -> float:
             with contextlib.suppress(OSError):
                 os.unlink(stale)
     else:
-        spill = args.trace_spill or None
+        spill = trace_spill or None
         if spill and jax.process_index() > 0:
             spill = f"{spill}.host{jax.process_index()}"
         # Ring sized to one epoch (~5 serial+overlap spans per step plus

@@ -24,4 +24,11 @@ def get_model(name: str) -> ModelDef:
     if name == "resnet18":
         from . import resnet
         return ModelDef("resnet18", resnet.init, resnet.apply)
-    raise ValueError(f"unknown model {name!r}; available: vgg, deepnn, resnet18")
+    if name == "transformer":
+        from . import transformer
+        return ModelDef("transformer", transformer.init, transformer.apply)
+    if name == "tinylm":
+        from . import transformer
+        return ModelDef("tinylm", transformer.lm_init, transformer.lm_apply)
+    raise ValueError(f"unknown model {name!r}; available: vgg, deepnn, "
+                     "resnet18, transformer, tinylm")

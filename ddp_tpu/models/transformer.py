@@ -1,0 +1,482 @@
+"""Transformer workloads: a CIFAR patch encoder and a byte-level LM.
+
+Two models share one block implementation (pre-LN attention + MLP) and
+one parameter layout, so a single ``TP_RECIPE`` describes both:
+
+- ``transformer`` — a small vision transformer over 4x4 CIFAR patches
+  (64 tokens x 48 dims -> d_model), mean-pooled into the same 10-way
+  classifier head every other model in the zoo exposes.  Same uint8
+  [N,32,32,3] wire format, so the Trainer, data loaders, serve engine
+  and registry programs all apply unchanged.
+- ``tinylm`` — a decoder-only byte LM (vocab 256, causal blocks, weight
+  layout identical to the encoder's blocks).  Its forward has a second,
+  incremental form (:func:`lm_prefill` / :func:`lm_decode_step`) that
+  reads and writes a per-stream KV cache — the serving-side decode path
+  (serve/kvcache.py).
+
+Tensor-parallel layout (the canonical Megatron pattern, arXiv:1909.08053;
+named-axis composition per Mesh-TensorFlow, arXiv:1811.02084):
+
+- ``attn/qkv`` is ONE fused column layer ([d, 3d], head-major output
+  columns): a contiguous 1/m column shard is a whole group of heads with
+  their q, k and v rows — attention itself then runs on local heads with
+  ZERO communication, and the backward contributes exactly one
+  ``column_input`` psum.
+- ``attn/out`` is row-parallel ([h*hd, d], head-major rows): the one
+  forward psum per attention block happens after the output projection.
+- ``mlp/fc1`` column / ``mlp/fc2`` row — the standard pair.
+- LayerNorms, embeddings and the output head stay replicated.
+
+Per block that is fwd=2 / bwd=2 psums over ``model``; see
+``expected_collectives_by_layer`` (parallel/tp/plan.py) for the named
+per-layer table the auditor prints on a mismatch.
+
+Pipeline seam: the residual stream makes per-recipe-layer cuts
+meaningless, so ``PP_BLOCKS`` is coarse — embed / one entry per
+transformer block / head — and every block hands over the full-width
+[B, T, d] stream (``PP_SHARDED_OUT`` is empty).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import initializers as init_lib
+from ..ops.layers import linear
+
+NAME = "transformer"
+LM_NAME = "tinylm"
+NUM_CLASSES = 10
+
+# Shared architecture constants (both models; kept small enough that the
+# whole CPU-mesh test matrix traces and runs in seconds).
+PATCH = 4                       # 4x4 patches -> 64 tokens of 48 dims
+TOKENS = (32 // PATCH) ** 2     # 64
+PATCH_DIM = PATCH * PATCH * 3   # 48
+D_MODEL = 64
+N_HEADS = 4
+HEAD_DIM = D_MODEL // N_HEADS   # 16
+N_LAYERS = 2
+MLP_HIDDEN = 4 * D_MODEL        # 256
+
+# LM-specific
+VOCAB = 256                     # byte-level
+T_MAX = 128                     # positional table / KV-cache depth bound
+
+# Marks the LM for the analysis registry (analysis/programs.py): token
+# batches + the lm_* program set instead of the CIFAR classifier set.
+LM_WORKLOAD = "lm"
+
+# One recipe serves both models: the param paths below exist in both
+# trees (parallel/tp/plan.py matches rules by path prefix).
+TP_RECIPE = {}
+for _i in range(N_LAYERS):
+    TP_RECIPE[f"blocks/block{_i}/attn/qkv"] = "column"
+    TP_RECIPE[f"blocks/block{_i}/attn/out"] = "row"
+    TP_RECIPE[f"blocks/block{_i}/mlp/fc1"] = "column"
+    TP_RECIPE[f"blocks/block{_i}/mlp/fc2"] = "row"
+del _i
+
+# No barrier layers: every row output is already full-width, and the
+# residual stream never crosses a sharded reshape.
+TP_BARRIERS = ()
+
+# The network input feeds the REPLICATED patch/token embedding, not a
+# column layer, so no stem elision applies: every column layer's
+# backward input psum is live (the cotangent flows into the residual
+# stream and the embedding parameters above it).
+TP_STEM = None
+
+# Coarse pipeline blocks: the residual stream forbids cutting inside a
+# transformer block, so each block is one unit.  Block "blocks/blockN"
+# owns params["blocks"]["blockN"] (the PP_BLOCKS subtree contract); the
+# recipe layers UNDER a block are counted by prefix match in
+# parallel/pp/partition.py:stage_model_psums.
+PP_BLOCKS = ("embed",) + tuple(
+    f"blocks/block{i}" for i in range(N_LAYERS)) + ("head",)
+
+# Every block output is the full-width residual stream (row outputs are
+# psum'd inside the block) -> no sharded handoffs, every cut is legal.
+PP_SHARDED_OUT = ()
+
+Params = Dict[str, Any]
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+# ---------------------------------------------------------------------------
+# init
+
+
+def _ln_params(d: int, dtype) -> Dict[str, jax.Array]:
+    return {"scale": jnp.ones((d,), dtype), "bias": jnp.zeros((d,), dtype)}
+
+
+def _block_init(key: jax.Array, dtype) -> Dict[str, Any]:
+    kq, kqb, ko, kob, k1, k1b, k2, k2b = jax.random.split(key, 8)
+    return {
+        "ln1": _ln_params(D_MODEL, dtype),
+        "attn": {
+            "qkv": {"weight": init_lib.linear_weight(kq, D_MODEL,
+                                                     3 * D_MODEL, dtype),
+                    "bias": init_lib.linear_bias(kqb, D_MODEL,
+                                                 3 * D_MODEL, dtype)},
+            "out": {"weight": init_lib.linear_weight(ko, D_MODEL,
+                                                     D_MODEL, dtype),
+                    "bias": init_lib.linear_bias(kob, D_MODEL,
+                                                 D_MODEL, dtype)},
+        },
+        "ln2": _ln_params(D_MODEL, dtype),
+        "mlp": {
+            "fc1": {"weight": init_lib.linear_weight(k1, D_MODEL,
+                                                     MLP_HIDDEN, dtype),
+                    "bias": init_lib.linear_bias(k1b, D_MODEL,
+                                                 MLP_HIDDEN, dtype)},
+            "fc2": {"weight": init_lib.linear_weight(k2, MLP_HIDDEN,
+                                                     D_MODEL, dtype),
+                    "bias": init_lib.linear_bias(k2b, MLP_HIDDEN,
+                                                 D_MODEL, dtype)},
+        },
+    }
+
+
+def init(key: jax.Array, dtype=jnp.float32) -> Tuple[Params, Dict]:
+    """The CIFAR encoder's parameters (no batch-norm -> no stats)."""
+    kp, kpos, khead, *kblocks = jax.random.split(key, 3 + N_LAYERS)
+    params: Params = {
+        "embed": {
+            "patch": {"weight": init_lib.linear_weight(kp, PATCH_DIM,
+                                                       D_MODEL, dtype),
+                      "bias": init_lib.linear_bias(kp, PATCH_DIM,
+                                                   D_MODEL, dtype)},
+            "pos": 0.02 * jax.random.normal(kpos, (TOKENS, D_MODEL), dtype),
+        },
+        "blocks": {f"block{i}": _block_init(kblocks[i], dtype)
+                   for i in range(N_LAYERS)},
+        "head": {
+            "ln": _ln_params(D_MODEL, dtype),
+            "linear": {"weight": init_lib.linear_weight(khead, D_MODEL,
+                                                        NUM_CLASSES, dtype),
+                       "bias": init_lib.linear_bias(khead, D_MODEL,
+                                                    NUM_CLASSES, dtype)},
+        },
+    }
+    return params, {}
+
+
+def lm_init(key: jax.Array, dtype=jnp.float32) -> Tuple[Params, Dict]:
+    """The byte LM's parameters — same block subtree paths as the
+    encoder, so TP_RECIPE (and any plan built from it) covers both."""
+    ktok, kpos, khead, *kblocks = jax.random.split(key, 3 + N_LAYERS)
+    params: Params = {
+        "embed": {
+            "tok": 0.02 * jax.random.normal(ktok, (VOCAB, D_MODEL), dtype),
+            "pos": 0.02 * jax.random.normal(kpos, (T_MAX, D_MODEL), dtype),
+        },
+        "blocks": {f"block{i}": _block_init(kblocks[i], dtype)
+                   for i in range(N_LAYERS)},
+        "head": {
+            "ln": _ln_params(D_MODEL, dtype),
+            "linear": {"weight": init_lib.linear_weight(khead, D_MODEL,
+                                                        VOCAB, dtype),
+                       "bias": init_lib.linear_bias(khead, D_MODEL,
+                                                    VOCAB, dtype)},
+        },
+    }
+    return params, {}
+
+
+# ---------------------------------------------------------------------------
+# shared forward pieces
+
+
+def _layer_norm(x: jax.Array, p: Dict[str, jax.Array]) -> jax.Array:
+    """LayerNorm with fp32 statistics (the cast costs nothing in fp32
+    and keeps bf16 runs stable), output back in x's dtype."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + 1e-6)
+    return (y * p["scale"].astype(jnp.float32)
+            + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _split_heads(qkv: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """[..., 3*h*hd] head-major -> (q, k, v) each [..., h, hd].  The
+    reshape DEFINES the fused layout: column j = (head, {q,k,v}, dim),
+    so a contiguous column shard is whole heads — the one property the
+    TP path depends on."""
+    *lead, width = qkv.shape
+    h = width // (3 * HEAD_DIM)
+    qkv = qkv.reshape(*lead, h, 3, HEAD_DIM)
+    return qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+
+
+def _attention(q: jax.Array, k: jax.Array, v: jax.Array,
+               mask: Optional[jax.Array]) -> jax.Array:
+    """[B,Tq,h,hd] x [B,Tk,h,hd] -> [B,Tq,h,hd]; softmax statistics in
+    fp32 (guide-standard), additive mask pre-softmax."""
+    scale = 1.0 / float(HEAD_DIM) ** 0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _qkv_proj(x, blk, path, style_fn, tp_axis, cd):
+    p = blk["attn"]["qkv"]
+    w, b = p["weight"].astype(cd), p["bias"].astype(cd)
+    if style_fn(f"{path}/attn/qkv") == "column":
+        from ..parallel.tp.layers import column_linear
+        return column_linear(x, w, b, tp_axis)
+    return linear(x, w, b)
+
+
+def _out_proj(x, blk, path, style_fn, tp_axis, cd):
+    p = blk["attn"]["out"]
+    w, b = p["weight"].astype(cd), p["bias"].astype(cd)
+    if style_fn(f"{path}/attn/out") == "row":
+        from ..parallel.tp.layers import row_linear
+        return row_linear(x, w, b, tp_axis)
+    return linear(x, w, b)
+
+
+def _mlp(x, blk, path, style_fn, tp_axis, cd):
+    p1, p2 = blk["mlp"]["fc1"], blk["mlp"]["fc2"]
+    w1, b1 = p1["weight"].astype(cd), p1["bias"].astype(cd)
+    w2, b2 = p2["weight"].astype(cd), p2["bias"].astype(cd)
+    if style_fn(f"{path}/mlp/fc1") == "column":
+        from ..parallel.tp.layers import column_linear
+        h = column_linear(x, w1, b1, tp_axis)
+    else:
+        h = linear(x, w1, b1)
+    h = jax.nn.gelu(h)
+    if style_fn(f"{path}/mlp/fc2") == "row":
+        from ..parallel.tp.layers import row_linear
+        return row_linear(h, w2, b2, tp_axis)
+    return linear(h, w2, b2)
+
+
+def _block_forward(blk, path, x, *, causal, style_fn, tp_axis, cd):
+    """One pre-LN block over the full sequence.  Returns the new
+    residual stream and this block's (k, v) tensors ([B, T, h_local,
+    hd]) so prefill can seed a KV cache from the same trace."""
+    h = _layer_norm(x, blk["ln1"])
+    qkv = _qkv_proj(h, blk, path, style_fn, tp_axis, cd)
+    q, k, v = _split_heads(qkv)
+    mask = None
+    if causal:
+        t = x.shape[-2]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (t, t), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (t, t), 1)
+        mask = (cols <= rows)[None, None, :, :]
+    a = _attention(q, k, v, mask)
+    a = a.reshape(*a.shape[:-2], -1)  # [B,T,h,hd] -> [B,T,h*hd] head-major
+    x = x + _out_proj(a, blk, path, style_fn, tp_axis, cd)
+    x = x + _mlp(_layer_norm(x, blk["ln2"]), blk, path, style_fn,
+                 tp_axis, cd)
+    return x, (k, v)
+
+
+def _make_style_fn(tp_axis, tp_recipe):
+    recipe = TP_RECIPE if tp_recipe is None else tp_recipe
+
+    def style(p):
+        if tp_axis is None:
+            return None
+        return recipe.get(p, "replicated")
+    return style
+
+
+def _patchify(x: jax.Array) -> jax.Array:
+    """[B,32,32,3] -> [B, 64, 48] of 4x4 patches (row-major)."""
+    b = x.shape[0]
+    g = 32 // PATCH
+    x = x.reshape(b, g, PATCH, g, PATCH, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(b, TOKENS, PATCH_DIM)
+
+
+# ---------------------------------------------------------------------------
+# CIFAR encoder
+
+
+def apply(params: Params, batch_stats: Dict, x: jax.Array, *, train: bool,
+          rng: Optional[jax.Array] = None,
+          compute_dtype: Optional[jnp.dtype] = None,
+          tp_axis: Optional[str] = None,
+          tp_recipe: Optional[Dict[str, str]] = None,
+          ) -> Tuple[jax.Array, Dict]:
+    """Encoder forward.  Under ``tp_axis`` (inside a shard_map over that
+    mesh axis, params sharded per the recipe) the fused QKV / fc1 run
+    column-parallel and out / fc2 row-parallel; everything else is
+    replicated compute.  No dropout, so ``rng`` is accepted and unused —
+    the shared step builders pass it unconditionally."""
+    return apply_blocks(params, batch_stats, x, blocks=(0, len(PP_BLOCKS)),
+                        train=train, rng=rng, compute_dtype=compute_dtype,
+                        tp_axis=tp_axis, tp_recipe=tp_recipe)
+
+
+def apply_blocks(params: Params, batch_stats: Dict, x: jax.Array, *,
+                 blocks: Tuple[int, int], train: bool,
+                 rng: Optional[jax.Array] = None,
+                 compute_dtype: Optional[jnp.dtype] = None,
+                 tp_axis: Optional[str] = None,
+                 tp_recipe: Optional[Dict[str, str]] = None,
+                 ) -> Tuple[jax.Array, Dict]:
+    """Run the contiguous PP_BLOCKS range ``blocks=(lo, hi)``; ``x`` is
+    the image batch for ``lo == 0``, else the [B, T, d] residual stream
+    handed over from the previous stage.  ``(0, len(PP_BLOCKS))`` IS
+    :func:`apply`, so staged and unstaged paths cannot drift."""
+    del batch_stats, train, rng  # no BN, no dropout
+    lo, hi = blocks
+    if not 0 <= lo < hi <= len(PP_BLOCKS):
+        raise ValueError(
+            f"blocks must be a non-empty range within "
+            f"(0, {len(PP_BLOCKS)}), got {blocks!r}")
+    style = _make_style_fn(tp_axis, tp_recipe)
+    cd = compute_dtype or x.dtype
+    x = x.astype(cd)
+
+    for name in PP_BLOCKS[lo:hi]:
+        if name == "embed":
+            e = params["embed"]
+            x = _patchify(x)
+            x = linear(x, e["patch"]["weight"].astype(cd),
+                       e["patch"]["bias"].astype(cd))
+            x = x + e["pos"].astype(cd)[None, :, :]
+        elif name == "head":
+            hd = params["head"]
+            x = _layer_norm(x, hd["ln"])
+            x = jnp.mean(x, axis=-2)  # mean-pool tokens
+            x = linear(x, hd["linear"]["weight"].astype(cd),
+                       hd["linear"]["bias"].astype(cd))
+            x = x.astype(jnp.float32)
+        else:
+            blk = params["blocks"][name.split("/", 1)[1]]
+            x, _ = _block_forward(blk, name, x, causal=False,
+                                  style_fn=style, tp_axis=tp_axis, cd=cd)
+    return x, {}
+
+
+# ---------------------------------------------------------------------------
+# decoder-only LM
+
+
+def lm_apply(params: Params, batch_stats: Dict, tokens: jax.Array, *,
+             train: bool, rng: Optional[jax.Array] = None,
+             compute_dtype: Optional[jnp.dtype] = None,
+             tp_axis: Optional[str] = None,
+             tp_recipe: Optional[Dict[str, str]] = None,
+             ) -> Tuple[jax.Array, Dict]:
+    """Full-sequence causal forward: int tokens [B, T] -> fp32 logits
+    [B, T, VOCAB].  The uncached reference the KV-cached decode is
+    parity-tested against (tests/test_kvcache.py)."""
+    del batch_stats, train, rng
+    if tokens.shape[-1] > T_MAX:
+        raise ValueError(f"sequence length {tokens.shape[-1]} exceeds "
+                         f"T_MAX={T_MAX}")
+    style = _make_style_fn(tp_axis, tp_recipe)
+    cd = compute_dtype or jnp.float32
+    e = params["embed"]
+    t = tokens.shape[-1]
+    x = e["tok"].astype(cd)[tokens] + e["pos"].astype(cd)[None, :t, :]
+    for i in range(N_LAYERS):
+        x, _ = _block_forward(params["blocks"][f"block{i}"],
+                              f"blocks/block{i}", x, causal=True,
+                              style_fn=style, tp_axis=tp_axis, cd=cd)
+    hd = params["head"]
+    x = _layer_norm(x, hd["ln"])
+    x = linear(x, hd["linear"]["weight"].astype(cd),
+               hd["linear"]["bias"].astype(cd))
+    return x.astype(jnp.float32), {}
+
+
+def lm_prefill(params: Params, tokens: jax.Array, *,
+               compute_dtype: Optional[jnp.dtype] = None,
+               tp_axis: Optional[str] = None,
+               tp_recipe: Optional[Dict[str, str]] = None,
+               ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Causal forward over a prompt [B, T_bucket] that ALSO returns the
+    per-block key/value tensors: (logits [B, T, V] fp32, k, v) with
+    k/v stacked [L, B, T, h_local, hd] — the slot image a KV cache
+    stores.  Padding beyond the true prompt length is masked at decode
+    time (by the stream's length), never here."""
+    style = _make_style_fn(tp_axis, tp_recipe)
+    cd = compute_dtype or jnp.float32
+    e = params["embed"]
+    t = tokens.shape[-1]
+    x = e["tok"].astype(cd)[tokens] + e["pos"].astype(cd)[None, :t, :]
+    ks, vs = [], []
+    for i in range(N_LAYERS):
+        x, (k, v) = _block_forward(params["blocks"][f"block{i}"],
+                                   f"blocks/block{i}", x, causal=True,
+                                   style_fn=style, tp_axis=tp_axis, cd=cd)
+        ks.append(k)
+        vs.append(v)
+    hd = params["head"]
+    x = _layer_norm(x, hd["ln"])
+    x = linear(x, hd["linear"]["weight"].astype(cd),
+               hd["linear"]["bias"].astype(cd))
+    return (x.astype(jnp.float32),
+            jnp.stack(ks, axis=0), jnp.stack(vs, axis=0))
+
+
+def lm_decode_step(params: Params, tokens: jax.Array, positions: jax.Array,
+                   k_cache: jax.Array, v_cache: jax.Array, *,
+                   compute_dtype: Optional[jnp.dtype] = None,
+                   tp_axis: Optional[str] = None,
+                   tp_recipe: Optional[Dict[str, str]] = None,
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One incremental decode step over every cache slot.
+
+    ``tokens`` [S] int32 (this step's input token per slot),
+    ``positions`` [S] int32 (its position: the slot's current length),
+    ``k_cache``/``v_cache`` [L, S, T_max, h_local, hd].  Inactive slots
+    simply compute garbage that the caller never reads — the program
+    shape is FIXED so serving compiles it exactly once.
+
+    Returns (logits [S, V] fp32, new_k_cache, new_v_cache) with this
+    step's k/v written at ``positions`` (per-slot scatter via a vmapped
+    dynamic_update_slice — the cache-update program the auditor prices).
+    """
+    style = _make_style_fn(tp_axis, tp_recipe)
+    cd = compute_dtype or jnp.float32
+    e = params["embed"]
+    t_max = k_cache.shape[2]
+    # [S] -> [S, 1, d]: token embedding + per-slot positional row.
+    x = (e["tok"].astype(cd)[tokens]
+         + e["pos"].astype(cd)[positions])[:, None, :]
+
+    def write(cache_l, new, pos):
+        # cache_l [T_max, h, hd], new [1, h, hd], pos scalar
+        return jax.lax.dynamic_update_slice_in_dim(cache_l, new, pos, axis=0)
+
+    new_k, new_v = [], []
+    for i in range(N_LAYERS):
+        blk = params["blocks"][f"block{i}"]
+        path = f"blocks/block{i}"
+        h = _layer_norm(x, blk["ln1"])
+        qkv = _qkv_proj(h, blk, path, style, tp_axis, cd)
+        q, k, v = _split_heads(qkv)          # [S, 1, h, hd]
+        kc = jax.vmap(write)(k_cache[i].astype(cd), k, positions)
+        vc = jax.vmap(write)(v_cache[i].astype(cd), v, positions)
+        new_k.append(kc)
+        new_v.append(vc)
+        # Attend over the cache up to and including this position.
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (t_max,), 0)[None, :]
+                 <= positions[:, None])        # [S, T_max]
+        a = _attention(q, kc, vc, valid[:, None, None, :])
+        a = a.reshape(*a.shape[:-2], -1)
+        x = x + _out_proj(a, blk, path, style, tp_axis, cd)
+        x = x + _mlp(_layer_norm(x, blk["ln2"]), blk, path, style,
+                     tp_axis, cd)
+    hd = params["head"]
+    x = _layer_norm(x, hd["ln"])
+    x = linear(x, hd["linear"]["weight"].astype(cd),
+               hd["linear"]["bias"].astype(cd))
+    return (x[:, 0, :].astype(jnp.float32),
+            jnp.stack(new_k, axis=0), jnp.stack(new_v, axis=0))

@@ -49,6 +49,17 @@ import time
 from typing import IO, Dict, List, Optional
 
 
+def default_spill_path(snapshot_path: str, filename: str) -> str:
+    """Default spill location for a run: next to its checkpoint head,
+    NOT the process CWD.  A bare-CWD default litters whatever directory
+    the CLI happened to launch from (and once landed a spill in the repo
+    root); anchoring on ``--snapshot_path`` puts the telemetry where the
+    run's other artifacts live.  Explicit ``--trace_spill`` paths are
+    always honored verbatim — this only fills the unset default."""
+    head = os.path.dirname(snapshot_path)
+    return os.path.join(head, filename) if head else filename
+
+
 class _NullSpan:
     """Shared no-op context manager — the entire cost of a disabled span."""
     __slots__ = ()

@@ -24,7 +24,7 @@ import importlib
 from typing import Dict, NamedTuple, Optional, Tuple
 
 # Registry name -> module name where it differs (same map as tp/plan.py).
-_MODULE_FOR = {"resnet18": "resnet"}
+_MODULE_FOR = {"resnet18": "resnet", "tinylm": "transformer"}
 
 
 class StagePlan(NamedTuple):
@@ -234,17 +234,26 @@ def stage_model_psums(plan: StagePlan, tp_plan, k: int, *,
         raise ValueError(f"unknown stage program role {role!r}")
     if tp_plan is None or role == "update":
         return 0
-    styles = dict(tp_plan.layers)
     lo, hi = plan.stages[k]
     names = plan.block_names[lo:hi]
-    n_row = sum(1 for b in names if styles.get(b) == "row")
-    n_col = sum(1 for b in names if styles.get(b) == "column")
+
+    def under(layer: str) -> bool:
+        # A recipe layer belongs to the stage owning its block.  Fine-
+        # grained models (deepnn) name recipe layers AS blocks (layer ==
+        # block); coarse models (transformer) put several recipe layers
+        # UNDER one block ("blocks/block0" owns "blocks/block0/attn/qkv"
+        # etc.) — same prefix rule the tp planner applies to param paths.
+        return any(layer == b or layer.startswith(b + "/") for b in names)
+
+    layers = [(p, s) for p, s in tp_plan.layers if under(p)]
+    n_row = sum(1 for _, s in layers if s == "row")
+    n_col = sum(1 for _, s in layers if s == "column")
     if role == "forward":
         return n_row
     if role == "fwdbwd":
         return n_row + n_col
-    elide = (k == 0 and tp_plan.stem in names
-             and styles.get(tp_plan.stem) == "column")
+    elide = (k == 0 and any(p == tp_plan.stem and s == "column"
+                            for p, s in layers))
     return n_row + n_col - (1 if elide else 0)
 
 

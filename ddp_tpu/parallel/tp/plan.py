@@ -36,7 +36,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..mesh import DATA_AXIS, MODEL_AXIS, model_axis_size
 
 # Model registry name -> module name where it differs.
-_MODULE_FOR = {"resnet18": "resnet"}
+_MODULE_FOR = {"resnet18": "resnet", "tinylm": "transformer"}
 
 STYLES = ("column", "row")
 # Styles an EXPLICIT recipe (the auto-plan search's plan-as-data form,
@@ -238,6 +238,47 @@ def expected_collectives(plan: TPPlan, *, backward: bool) -> Dict[str, int]:
     bwd = (n_col - elided) if backward else 0
     return {"psum_model_fwd": n_row, "psum_model_bwd": bwd,
             "psum_model": n_row + bwd, "elided_stem_psum": elided}
+
+
+def expected_collectives_by_layer(plan: TPPlan, *,
+                                  backward: bool) -> Dict[str, Dict[str, int]]:
+    """The per-layer unit table behind :func:`expected_collectives`: an
+    ordered ``{layer path: {"fwd": n, "bwd": n}}`` mapping in recipe
+    (network) order.  Each ``row`` layer contributes one forward psum,
+    each ``column`` layer one backward psum (``backward=True`` only),
+    the declared stem's backward psum is elided.  The totals are — by
+    construction, pinned in tests/test_transformer.py — exactly the
+    aggregate counts ``expected_collectives`` returns, so an auditor
+    mismatch can name WHICH layer's arithmetic changed instead of
+    reporting a bare total (the attention-recipe satellite of ISSUE 20).
+    """
+    table: Dict[str, Dict[str, int]] = {}
+    for path, style in plan.layers:
+        fwd = 1 if style == "row" else 0
+        bwd = (1 if (backward and style == "column"
+                     and path != plan.stem) else 0)
+        table[path] = {"fwd": fwd, "bwd": bwd}
+    return table
+
+
+def format_collective_table(plan: TPPlan, *, backward: bool) -> str:
+    """One line per recipe layer (``path style fwd+bwd``) plus the
+    totals — the named breakdown the jaxpr auditor appends to a
+    collective-count mismatch so a recipe edit fails with a per-layer
+    delta, not a bare number."""
+    table = expected_collectives_by_layer(plan, backward=backward)
+    styles = dict(plan.layers)
+    lines = []
+    for path, counts in table.items():
+        note = (" (stem: bwd psum elided)"
+                if (backward and path == plan.stem
+                    and styles.get(path) == "column") else "")
+        lines.append(f"    {path} [{styles[path]}]: fwd={counts['fwd']} "
+                     f"bwd={counts['bwd']}{note}")
+    exp = expected_collectives(plan, backward=backward)
+    lines.append(f"    total: fwd={exp['psum_model_fwd']} "
+                 f"bwd={exp['psum_model_bwd']} = {exp['psum_model']}")
+    return "\n".join(lines)
 
 
 def is_trivial(plan: TPPlan) -> bool:

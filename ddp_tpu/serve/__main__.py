@@ -44,9 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "is loaded via resilience.lineage (default: "
                         "checkpoint.pt)")
     p.add_argument("--model", default="vgg",
-                   choices=["vgg", "deepnn", "resnet18"],
+                   choices=["vgg", "deepnn", "resnet18", "transformer",
+                            "tinylm"],
                    help="Model architecture the checkpoint was trained "
-                        "with (default: vgg — the reference's model)")
+                        "with (default: vgg — the reference's model); "
+                        "tinylm + --generate serves token streams")
     p.add_argument("--host", default="127.0.0.1",
                    help="Bind address (default 127.0.0.1; 0.0.0.0 to "
                         "expose)")
@@ -69,6 +71,23 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Admission queue bound; a full queue sheds with "
                         "503 instead of queueing into unbounded latency "
                         "(default 256 requests)")
+    p.add_argument("--generate", action="store_true",
+                   help="Generative decoding mode: front the tinylm "
+                        "decoder (models/transformer.py) with a KV-cache "
+                        "engine + token-level continuous batcher and "
+                        "serve POST /generate; /predict routes stay on "
+                        "classifier servers only")
+    p.add_argument("--slots", default=8, type=int,
+                   help="Generative only: concurrent KV-cache streams "
+                        "per replica (rounded up to a data-mesh "
+                        "multiple; default 8)")
+    p.add_argument("--prefill_buckets", default="16,64",
+                   help="Generative only: padded prompt-length buckets, "
+                        "comma-separated; prefill + cache-write compile "
+                        "once per bucket (default 16,64)")
+    p.add_argument("--max_new_tokens", default=32, type=int,
+                   help="Generative only: per-request generation cap "
+                        "(requests may ask for fewer; default 32)")
     p.add_argument("--fleet", default=0, type=int, metavar="N",
                    help="Serve N in-process engine replicas behind the "
                         "fault-tolerant router (health-driven ejection, "
@@ -86,13 +105,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Mesh size override (default: all visible "
                         "devices); formed batches shard across the same "
                         "data axis training uses")
-    p.add_argument("--trace_spill", default="serve_spill.jsonl",
+    p.add_argument("--trace_spill", default=None,
                    metavar="PATH",
                    help="Span spill (queue_wait/batch_form/pad/h2d/"
                         "forward/d2h), analyzable with python -m "
                         "ddp_tpu.obs exactly like a training spill; '' "
-                        "keeps tracing in-memory only (default "
-                        "serve_spill.jsonl)")
+                        "keeps tracing in-memory only (default: "
+                        "serve_spill.jsonl next to --snapshot_path, the "
+                        "run's output dir)")
     p.add_argument("--obs_off", action="store_true",
                    help="Telemetry kill-switch (the training CLI's "
                         "contract: no spans, no spill, zero overhead)")
@@ -112,10 +132,17 @@ def main(argv: Optional[list] = None) -> int:
     from .fleet import ServeFleet
     from .http import ServeHTTPServer
 
+    # Unset --trace_spill defaults next to the checkpoint head (the
+    # run's output dir), not the CWD; '' stays the explicit kill value.
+    from ..obs.tracer import default_spill_path
+    trace_spill = args.trace_spill
+    if trace_spill is None:
+        trace_spill = default_spill_path(args.snapshot_path,
+                                         "serve_spill.jsonl")
     if args.obs_off:
         tracer = NullTracer()
     else:
-        tracer = SpanTracer(spill_path=args.trace_spill or None,
+        tracer = SpanTracer(spill_path=trace_spill or None,
                             ring=65536, host=0)
     mesh = make_mesh(args.num_devices)
     registry = MetricsRegistry()  # one /metrics surface per process
@@ -126,6 +153,8 @@ def main(argv: Optional[list] = None) -> int:
         print(f"loading newest verifiable checkpoint under "
               f"{args.snapshot_path!r} ...", file=sys.stderr)
         fleet = engine = batcher = None
+        prefill_buckets = [int(b) for b in args.prefill_buckets.split(",")
+                           if b]
         if args.fleet >= 1:
             t0 = time.monotonic()
             fleet = ServeFleet(
@@ -134,7 +163,9 @@ def main(argv: Optional[list] = None) -> int:
                 compute_dtype=compute_dtype, max_batch=args.max_batch,
                 max_wait_ms=args.max_wait_ms,
                 queue_depth=args.queue_depth, tracer=tracer,
-                registry=registry)
+                registry=registry, generate=args.generate,
+                slots=args.slots, prompt_buckets=prefill_buckets,
+                max_new_tokens=args.max_new_tokens)
             install_serve_faults(fleet)
             fleet.start(poll_s=args.swap_poll_s)
             print(f"warmed {args.fleet} replica(s) in "
@@ -143,6 +174,29 @@ def main(argv: Optional[list] = None) -> int:
                   f"{'every %.1fs' % args.swap_poll_s if args.swap_poll_s > 0 else 'off'})",
                   file=sys.stderr)
             httpd = ServeHTTPServer((args.host, args.port), fleet=fleet)
+        elif args.generate:
+            from .kvcache import KVCacheEngine
+            from .token_batcher import TokenBatcher
+            engine = KVCacheEngine.from_checkpoint(
+                args.snapshot_path, args.model, mesh=mesh,
+                slots=args.slots, prompt_buckets=prefill_buckets,
+                compute_dtype=compute_dtype, tracer=tracer,
+                registry=registry)
+            t0 = time.monotonic()
+            compiled = engine.warm()
+            print(f"compiled {compiled} executable(s) (bound "
+                  f"{engine.compile_bound}: prefill+write per prompt "
+                  f"bucket {list(engine.prompt_buckets)} + 1 decode) in "
+                  f"{time.monotonic() - t0:.1f}s (checkpoint "
+                  f"{engine.checkpoint_file!r}, step "
+                  f"{engine.checkpoint_step}); no stream pays a compile",
+                  file=sys.stderr)
+            batcher = TokenBatcher(engine,
+                                   max_new_tokens=args.max_new_tokens,
+                                   queue_depth=args.queue_depth,
+                                   tracer=tracer,
+                                   registry=registry).start()
+            httpd = ServeHTTPServer((args.host, args.port), engine, batcher)
         else:
             engine = ServeEngine.from_checkpoint(
                 args.snapshot_path, args.model, mesh=mesh, buckets=buckets,
@@ -173,8 +227,9 @@ def main(argv: Optional[list] = None) -> int:
         host, port = httpd.server_address[:2]
         what = (f"{args.model} fleet of {args.fleet}" if fleet is not None
                 else args.model)
+        routes = ("/generate" if args.generate else "/predict")
         print(f"serving {what} on http://{host}:{port} "
-              "(/predict /healthz /stats /metrics); SIGTERM drains "
+              f"({routes} /healthz /stats /metrics); SIGTERM drains "
               "gracefully", flush=True)
         try:
             while guard is None or not guard.noticed():

@@ -104,6 +104,30 @@ class LocalReplica:
         # request it accepted — the consistent-snapshot guarantee.
         return batcher.submit(images, timeout=timeout, req_id=req)
 
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 req: Optional[str] = None,
+                 session: Optional[str] = None):
+        """The generative leg of the replica protocol: one blocking
+        stream on this replica's token batcher.  Same pin-before-call
+        swap guarantee as :meth:`submit` — a mid-stream hot-swap lets
+        the retiring batcher decode its accepted streams to completion
+        on the engine (and KV cache) that prefilled them."""
+        if self.crashed:
+            raise ReplicaCrashed(
+                f"replica {self.replica_id} is down (crash fault latched)")
+        _, batcher = self._pair()
+        if not hasattr(batcher, "generate"):
+            # A classifier replica: the CLIENT asked the wrong fleet —
+            # TypeError rides the router's no-retry ladder.
+            raise TypeError(
+                f"replica {self.replica_id} serves a classifier "
+                "(DynamicBatcher); start the fleet with generate=True "
+                "for token streams")
+        return batcher.generate(prompt, max_new_tokens=max_new_tokens,
+                                timeout=timeout, req_id=req,
+                                session=session)
+
     def queue_depth(self) -> int:
         _, batcher = self._pair()
         return batcher.queue_depth()
@@ -210,6 +234,47 @@ class HTTPReplica:
                 f"{type(e).__name__}: {e}") from None
         return np.asarray(out["logits"], np.float32)
 
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 req: Optional[str] = None,
+                 session: Optional[str] = None):
+        """POST /generate on the remote replica; identical error
+        taxonomy mapping to :meth:`submit`."""
+        payload = {"prompt": np.asarray(prompt).tolist()}
+        if max_new_tokens is not None:
+            payload["max_new_tokens"] = int(max_new_tokens)
+        if session is not None:
+            payload["session"] = session
+        headers = {"Content-Type": "application/json"}
+        if req is not None:
+            headers["X-Request-Id"] = req
+        http_req = urllib.request.Request(
+            self.base_url + "/generate", data=json.dumps(payload).encode(),
+            headers=headers)
+        try:
+            with urllib.request.urlopen(
+                    http_req, timeout=timeout if timeout is not None
+                    else 30.0) as r:
+                return json.load(r)
+        except urllib.error.HTTPError as e:
+            raise self._map_http_error(e) from None
+        except urllib.error.URLError as e:
+            if isinstance(e.reason, (socket.timeout, TimeoutError)):
+                raise TimeoutError(
+                    f"replica {self.replica_id} transport timeout: "
+                    f"{e.reason}") from None
+            raise ReplicaCrashed(
+                f"replica {self.replica_id} transport failure: "
+                f"{type(e).__name__}: {e}") from None
+        except (socket.timeout, TimeoutError) as e:
+            raise TimeoutError(
+                f"replica {self.replica_id} transport timeout: "
+                f"{e}") from None
+        except (OSError, ConnectionError) as e:
+            raise ReplicaCrashed(
+                f"replica {self.replica_id} transport failure: "
+                f"{type(e).__name__}: {e}") from None
+
     def _map_http_error(self, e: "urllib.error.HTTPError"):
         try:
             msg = json.load(e).get("error", "")
@@ -270,13 +335,25 @@ class ServeFleet:
                  compute_dtype=None, max_batch: Optional[int] = None,
                  max_wait_ms: float = 5.0, queue_depth: int = 256,
                  drain_timeout_s: float = 30.0, tracer=None,
-                 router_kwargs: Optional[dict] = None, registry=None):
+                 router_kwargs: Optional[dict] = None, registry=None,
+                 generate: bool = False, slots: int = 8,
+                 prompt_buckets=(16, 64), max_new_tokens: int = 32):
         if n_replicas < 1:
             raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+        self.generative = bool(generate)
+        if self.generative:
+            from ..models import transformer as tfm
+            if model_name != tfm.LM_NAME:
+                raise ValueError(
+                    f"generative fleets serve the {tfm.LM_NAME!r} decoder "
+                    f"(models/transformer.py), got {model_name!r}")
         self.snapshot_path = snapshot_path
         self.model_name = model_name
         self.mesh = mesh
         self.buckets = buckets
+        self.slots = slots
+        self.prompt_buckets = prompt_buckets
+        self.max_new_tokens = max_new_tokens
         self.compute_dtype = compute_dtype
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
@@ -344,22 +421,53 @@ class ServeFleet:
                 "--snapshot_path first)")
         return loaded
 
-    def _make_engine(self, ckpt, used: str,
-                     replica_id: str) -> ServeEngine:
-        from ..models import get_model
-        eng = ServeEngine(get_model(self.model_name), ckpt.params,
-                          ckpt.batch_stats, self.mesh,
-                          buckets=self.buckets,
-                          compute_dtype=self.compute_dtype,
-                          tracer=self.tracer, registry=self.registry,
-                          metric_labels={"replica": replica_id})
+    def _make_engine(self, ckpt, used: str, replica_id: str):
+        if self.generative:
+            from ..models import transformer as tfm
+            from .kvcache import KVCacheEngine
+            eng = KVCacheEngine(tfm, ckpt.params, self.mesh,
+                                slots=self.slots,
+                                prompt_buckets=self.prompt_buckets,
+                                compute_dtype=self.compute_dtype,
+                                plan=self._serving_plan(ckpt),
+                                tracer=self.tracer,
+                                registry=self.registry,
+                                metric_labels={"replica": replica_id})
+        else:
+            from ..models import get_model
+            eng = ServeEngine(get_model(self.model_name), ckpt.params,
+                              ckpt.batch_stats, self.mesh,
+                              buckets=self.buckets,
+                              compute_dtype=self.compute_dtype,
+                              tracer=self.tracer, registry=self.registry,
+                              metric_labels={"replica": replica_id})
         eng.checkpoint_file = used
         eng.checkpoint_epoch = int(ckpt.epoch)
         eng.checkpoint_step = int(ckpt.step)
         return eng
 
-    def _make_batcher(self, engine: ServeEngine,
-                      replica_id: str) -> DynamicBatcher:
+    def _serving_plan(self, ckpt):
+        """A TP layout plan when the SERVING mesh has a model axis; None
+        on the common 1-D data mesh (a TP-trained checkpoint reshards
+        onto it via ``load_for_mesh`` and serves replicated)."""
+        from ..parallel.mesh import MODEL_AXIS
+        if MODEL_AXIS not in self.mesh.axis_names:
+            return None
+        m = int(self.mesh.shape[MODEL_AXIS])
+        if m <= 1:
+            return None
+        from ..parallel.tp.plan import plan_for_model
+        return plan_for_model(self.model_name, ckpt.params, model_size=m)
+
+    def _make_batcher(self, engine, replica_id: str):
+        if self.generative:
+            from .token_batcher import TokenBatcher
+            return TokenBatcher(engine,
+                                max_new_tokens=self.max_new_tokens,
+                                queue_depth=self.queue_depth,
+                                tracer=self.tracer,
+                                registry=self.registry,
+                                metric_labels={"replica": replica_id})
         return DynamicBatcher(engine, max_batch=self.max_batch,
                               max_wait_ms=self.max_wait_ms,
                               queue_depth=self.queue_depth,
@@ -378,10 +486,16 @@ class ServeFleet:
         total = 0
         for eng in engines:
             compiled = eng.warm()
-            if compiled > len(eng.buckets):
+            # Classifier engines bound compiles at one-per-bucket; the
+            # KV-cache engine publishes its own bound (prefill + cache
+            # write per prompt bucket + one decode).
+            bound = getattr(eng, "compile_bound", None)
+            if bound is None:
+                bound = len(eng.buckets)
+            if compiled > bound:
                 raise RuntimeError(
-                    f"compile bound violated: {compiled} executables for "
-                    f"{len(eng.buckets)} buckets {list(eng.buckets)}")
+                    f"compile bound violated: {compiled} executables, "
+                    f"bound {bound}")
             total += compiled
         return total
 
@@ -475,6 +589,15 @@ class ServeFleet:
 
     def submit(self, images, timeout: Optional[float] = None):
         return self.router.submit(images, timeout=timeout)
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 session: Optional[str] = None):
+        """Fleet front door for one generative stream; sticky-routed by
+        ``session`` (see :meth:`Router.generate`)."""
+        return self.router.generate(prompt,
+                                    max_new_tokens=max_new_tokens,
+                                    timeout=timeout, session=session)
 
     def health(self) -> dict:
         """The fleet /healthz body: ok while ANY replica can take

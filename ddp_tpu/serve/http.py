@@ -10,6 +10,12 @@ the work, which is exactly the dynamic batcher's concurrency model):
   status codes: 400 malformed, 413 oversized (larger than the biggest
   bucket), 503 shed/draining with ``Retry-After`` — backpressure the
   client can act on, never an unbounded queue.
+- ``POST /generate`` body ``{"prompt": [token ids], "max_new_tokens":
+  N?, "session": "id"?}`` -> ``{"tokens": [generated ids],
+  "prompt_len": n, "ttft_ms": float}`` — the generative front door
+  (models/transformer.py decoder behind a KV-cache engine + token
+  batcher).  Same status-code taxonomy as ``/predict``; ``session``
+  is the fleet router's sticky-routing key.
 - ``GET /healthz``   liveness + which checkpoint is live, plus the
   identity fields a fleet router keys on: ``replica_id``,
   ``checkpoint_step``, ``uptime_s``, ``queue_depth``; flips to
@@ -119,6 +125,23 @@ class ServeHTTPServer(ThreadingHTTPServer):
             return self.fleet.submit(images, timeout=timeout)
         return self.batcher.submit(images, timeout=timeout, req_id=req_id)
 
+    def generate(self, prompt, max_new_tokens: Optional[int],
+                 timeout: float, session: Optional[str] = None,
+                 req_id: Optional[str] = None) -> dict:
+        if self.fleet is not None:
+            return self.fleet.generate(prompt,
+                                       max_new_tokens=max_new_tokens,
+                                       timeout=timeout, session=session)
+        if not hasattr(self.batcher, "generate"):
+            raise TypeError(
+                "this server fronts a classifier; start it with "
+                "--generate (models/transformer.py decoder) for "
+                "/generate")
+        return self.batcher.generate(prompt,
+                                     max_new_tokens=max_new_tokens,
+                                     timeout=timeout, req_id=req_id,
+                                     session=session)
+
     def metrics_exposition(self) -> Optional[str]:
         """Prometheus text for ``/metrics``: the fleet's shared registry
         when fronting a fleet, else the pair's; None when neither backend
@@ -205,13 +228,13 @@ class _Handler(BaseHTTPRequestHandler):
                 pass  # scraper gave up
         else:
             self._reply(404, {"error": f"no route {self.path!r}; try "
-                                       "/predict, /healthz, /stats, "
-                                       "/metrics"})
+                                       "/predict, /generate, /healthz, "
+                                       "/stats, /metrics"})
 
-    # -- POST /predict -----------------------------------------------------
+    # -- POST /predict, /generate ------------------------------------------
 
     def do_POST(self) -> None:  # noqa: N802 — stdlib naming
-        if self.path != "/predict":
+        if self.path not in ("/predict", "/generate"):
             self._reply(404, {"error": f"no route {self.path!r}"})
             return
         try:
@@ -227,21 +250,11 @@ class _Handler(BaseHTTPRequestHandler):
         except (json.JSONDecodeError, UnicodeDecodeError) as e:
             self._reply(400, {"error": f"body is not valid JSON: {e}"})
             return
-        instances = (payload.get("instances")
-                     if isinstance(payload, dict) else payload)
         try:
-            images = np.asarray(instances)
-            if images.ndim == 3:  # one bare image
-                images = images[None]
-            if not np.issubdtype(images.dtype, np.integer) or \
-                    images.min() < 0 or images.max() > 255:
-                raise ValueError(
-                    "pixel values must be integers in [0, 255] (uint8 — "
-                    "the training loaders' wire format)")
-            images = images.astype(np.uint8)
-            logits = self.server.submit(
-                images, timeout=REQUEST_TIMEOUT_S,
-                req_id=self.headers.get("X-Request-Id") or None)
+            if self.path == "/generate":
+                out = self._run_generate(payload)
+            else:
+                out = self._run_predict(payload)
         except RequestTooLarge as e:
             self._reply(413, {"error": str(e)})
             return
@@ -265,7 +278,44 @@ class _Handler(BaseHTTPRequestHandler):
             # 5xx the client can log and retry on, never a reset socket.
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        self._reply(200, {
+        self._reply(200, out)
+
+    def _run_predict(self, payload) -> dict:
+        instances = (payload.get("instances")
+                     if isinstance(payload, dict) else payload)
+        images = np.asarray(instances)
+        if images.ndim == 3:  # one bare image
+            images = images[None]
+        if not np.issubdtype(images.dtype, np.integer) or \
+                images.min() < 0 or images.max() > 255:
+            raise ValueError(
+                "pixel values must be integers in [0, 255] (uint8 — "
+                "the training loaders' wire format)")
+        images = images.astype(np.uint8)
+        logits = self.server.submit(
+            images, timeout=REQUEST_TIMEOUT_S,
+            req_id=self.headers.get("X-Request-Id") or None)
+        return {
             "predictions": np.argmax(logits, axis=-1).astype(int).tolist(),
             "logits": [[float(v) for v in row] for row in logits],
-        })
+        }
+
+    def _run_generate(self, payload) -> dict:
+        """Body ``{"prompt": [ids], "max_new_tokens": N?, "session":
+        id?}`` -> ``{"tokens": [...], "prompt_len": n, "ttft_ms":
+        float}``.  The session key is the ROUTER's sticky-routing
+        handle; single-pair servers accept and record it unused."""
+        if not isinstance(payload, dict) or "prompt" not in payload:
+            raise ValueError(
+                'body must be {"prompt": [token ids], "max_new_tokens"?, '
+                '"session"?}')
+        max_new = payload.get("max_new_tokens")
+        if max_new is not None:
+            max_new = int(max_new)
+        session = payload.get("session")
+        if session is not None and not isinstance(session, str):
+            raise ValueError("session must be a string id")
+        return self.server.generate(
+            payload["prompt"], max_new, timeout=REQUEST_TIMEOUT_S,
+            session=session,
+            req_id=self.headers.get("X-Request-Id") or None)

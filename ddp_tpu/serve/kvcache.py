@@ -1,0 +1,482 @@
+"""KV-cached generative decoding: the serve engine for the tiny LM.
+
+The classifier engine (serve/engine.py) compiles one forward per padded
+batch bucket; a generative model needs THREE program families, still a
+small fixed set so no request ever waits on a compile (the same
+Mesh-TensorFlow serving discipline, PAPERS.md arxiv 1811.02084):
+
+- ``prefill`` — one executable per padded PROMPT-length bucket: full
+  causal forward of one stream's prompt, returning the next-token logits
+  and the prompt's K/V stack (models/transformer.py:lm_prefill).  The
+  prompt batch is a single stream, so it is computed REPLICATED over the
+  ``data`` axis (no collective, the auditor's forward invariant) —
+  redundant work per prefill, bounded by the prompt bucket, in exchange
+  for never re-sharding a batch-of-one;
+- ``cache_write`` — one executable per prompt bucket: scatter the
+  prefilled K/V into the stream's cache SLOT.  The slot axis is sharded
+  over ``data``, so each shard writes iff it owns the slot (an
+  axis_index ownership test, no collective at all — this program is
+  registered and audited collective-free);
+- ``decode`` — ONE executable, ever: all S slots advance one token
+  (models/transformer.py:lm_decode_step — in-place
+  dynamic_update_slice writes at each stream's position, masked
+  attention over its valid prefix).  Inactive slots compute garbage that
+  is never read (their positions are dead until a prefill overwrites
+  from 0), which is what keeps the shape — and therefore the compile
+  count — FIXED regardless of which streams are live.  The cache
+  buffers are donated, so a decode step allocates no second cache.
+
+Cache layout: ``[n_layers, slots, T_MAX, n_heads, head_dim]`` x2 (K and
+V), slots sharded over ``data``, the heads dim sharded over ``model``
+under a TP plan (each model shard holds its own heads' cache — the
+attention stays zero-communication in decode exactly as in training;
+the only model-axis collectives are the recipe's row psums, priced by
+``expected_collectives`` and enforced by ``python -m ddp_tpu.analysis``).
+
+Mesh portability: checkpoints are canonical (replicated per-leaf), so a
+``--mesh_shape 2,4`` TP-trained LM snapshot loads onto a 1-D serving
+mesh through the same ``latest_verifiable`` + ``load_for_mesh`` walk the
+classifier engine uses — tests/test_kvcache.py pins the served logits
+against the training-side full-sequence forward at every step.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..obs.registry import MetricsRegistry
+from ..obs.tracer import get_tracer
+from ..parallel.mesh import DATA_AXIS, MODEL_AXIS, replicated_sharding
+from .engine import RequestTooLarge, ServeError  # noqa: F401 (re-export)
+
+
+def _wiring(plan):
+    """(param specs, tp_axis, tp_recipe, extra shard_map kwargs) — the
+    serve twin of train/step.py:_eval_wiring."""
+    from ..parallel.tp.plan import is_trivial, recipe_override
+    if plan is None or is_trivial(plan):
+        return P(), None, None, {}
+    return (plan.param_specs, MODEL_AXIS, recipe_override(plan),
+            {"check_vma": False})
+
+
+def _cache_specs(mesh, plan) -> Tuple[P, P]:
+    """(cache spec, fresh-K/V spec).  Cache ``[L, S, T, h, hd]``: slots on
+    ``data``, heads on ``model`` under a plan; fresh prefill K/V
+    ``[L, T, h, hd]`` is replicated over ``data`` (single stream), heads
+    on ``model``."""
+    tp = plan is not None and MODEL_AXIS in mesh.axis_names
+    return (P(None, DATA_AXIS, None, MODEL_AXIS if tp else None, None),
+            P(None, None, MODEL_AXIS if tp else None, None))
+
+
+def make_lm_prefill(module, mesh, *, compute_dtype=None, plan=None,
+                    on_trace=None):
+    """Jitted prompt prefill: ``fn(params, tokens[T]) -> (logits[T, V],
+    k[L, T, h, hd], v[L, T, h, hd])`` — one stream, computed replicated
+    over ``data`` (heads sharded over ``model`` under ``plan``).  One
+    executable per padded T bucket."""
+    p_specs, tp_axis, tp_recipe, extra = _wiring(plan)
+    _, kv_spec = _cache_specs(mesh, plan)
+
+    def _shard_body(params, tokens):
+        if on_trace is not None:
+            on_trace()
+        logits, k, v = module.lm_prefill(
+            params, tokens[None, :], compute_dtype=compute_dtype,
+            tp_axis=tp_axis, tp_recipe=tp_recipe)
+        return logits[0], k[:, 0], v[:, 0]
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(p_specs, P()),
+        out_specs=(P(), kv_spec, kv_spec),
+        **extra,
+    )
+    return jax.jit(mapped, out_shardings=(
+        replicated_sharding(mesh), NamedSharding(mesh, kv_spec),
+        NamedSharding(mesh, kv_spec)))
+
+
+def make_cache_write(mesh, plan=None, *, on_trace=None):
+    """Jitted slot scatter: ``fn(k_cache, v_cache, k_new[L, T_b, h, hd],
+    v_new, slot) -> (k_cache, v_cache)`` — writes the prefilled K/V into
+    ``slot`` at positions ``0..T_b-1``.  The slot axis is sharded over
+    ``data``: each shard writes iff it owns the slot (pure ownership
+    arithmetic — this program is collective-free and audited so).  Cache
+    args are donated; one executable per prompt bucket."""
+    cache_spec, kv_spec = _cache_specs(mesh, plan)
+    extra = {} if plan is None else {"check_vma": False}
+
+    def _shard_body(k_cache, v_cache, k_new, v_new, slot):
+        if on_trace is not None:
+            on_trace()
+        s_local = k_cache.shape[1]
+        li = slot - lax.axis_index(DATA_AXIS) * s_local
+        owns = (li >= 0) & (li < s_local)
+        li = jnp.clip(li, 0, s_local - 1)
+
+        def write(cache, new):
+            cur = lax.dynamic_index_in_dim(cache, li, axis=1,
+                                           keepdims=False)
+            upd = lax.dynamic_update_slice(
+                cur, new.astype(cache.dtype), (0, 0, 0, 0))
+            upd = jnp.where(owns, upd, cur)
+            return lax.dynamic_update_index_in_dim(cache, upd, li, axis=1)
+
+        return write(k_cache, k_new), write(v_cache, v_new)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(cache_spec, cache_spec, kv_spec, kv_spec, P()),
+        out_specs=(cache_spec, cache_spec),
+        **extra,
+    )
+    sh = NamedSharding(mesh, cache_spec)
+    return jax.jit(mapped, donate_argnums=(0, 1),
+                   out_shardings=(sh, sh))
+
+
+def make_lm_decode(module, mesh, *, compute_dtype=None, plan=None,
+                   on_trace=None):
+    """Jitted decode step: ``fn(params, tokens[S], positions[S], k_cache,
+    v_cache) -> (logits[S, V], k_cache, v_cache)`` — every slot advances
+    one token (write at its position, attend over its valid prefix).
+    Slots sharded over ``data``, heads over ``model``; cache donated.
+    ONE executable for the whole serving run — the fixed [S] shape is
+    the compile-bound contract."""
+    p_specs, tp_axis, tp_recipe, extra = _wiring(plan)
+    cache_spec, _ = _cache_specs(mesh, plan)
+
+    def _shard_body(params, tokens, positions, k_cache, v_cache):
+        if on_trace is not None:
+            on_trace()
+        return module.lm_decode_step(
+            params, tokens, positions, k_cache, v_cache,
+            compute_dtype=compute_dtype, tp_axis=tp_axis,
+            tp_recipe=tp_recipe)
+
+    mapped = jax.shard_map(
+        _shard_body, mesh=mesh,
+        in_specs=(p_specs, P(DATA_AXIS), P(DATA_AXIS), cache_spec,
+                  cache_spec),
+        out_specs=(P(DATA_AXIS), cache_spec, cache_spec),
+        **extra,
+    )
+    sh = NamedSharding(mesh, cache_spec)
+    return jax.jit(mapped, donate_argnums=(3, 4),
+                   out_shardings=(NamedSharding(mesh, P(DATA_AXIS)),
+                                  sh, sh))
+
+
+def resolve_prompt_buckets(buckets: Sequence[int],
+                           t_max: int) -> Tuple[int, ...]:
+    """The padded prompt-length bucket set: deduplicated, ascending,
+    clamped into ``[1, t_max]`` — unlike batch buckets there is no
+    mesh-multiple rounding (the T axis is never sharded)."""
+    if not buckets:
+        raise ValueError("need at least one prompt bucket")
+    if any(b < 1 for b in buckets):
+        raise ValueError(f"prompt buckets must be >= 1, got {list(buckets)}")
+    out = tuple(sorted({min(int(b), t_max) for b in buckets}))
+    return out
+
+
+class SlotsExhausted(ServeError):
+    """Every KV-cache slot is occupied — admission-level backpressure;
+    the token batcher queues behind this, never the engine."""
+
+
+class KVCacheEngine:
+    """Slot-managed generative decoding over a fixed compiled-program set.
+
+    Single-caller by design (the token batcher's engine thread is the one
+    caller); a lock still guards the pipeline so misuse degrades to
+    serialization.  The compile-bound contract: ``2 * len(prompt
+    buckets) + 1`` executables (prefill + cache-write per bucket, one
+    decode), proved by ``trace_count`` exactly like the classifier
+    engine.
+    """
+
+    def __init__(self, module, params, mesh, *, slots: int = 8,
+                 prompt_buckets: Sequence[int] = (16, 64),
+                 compute_dtype=None, plan=None, tracer=None,
+                 registry=None, metric_labels=None):
+        d = int(mesh.shape[DATA_AXIS])
+        slots = -(-int(slots) // d) * d  # data-shardable slot count
+        self.module = module
+        self.mesh = mesh
+        self.compute_dtype = compute_dtype
+        self.slots = slots
+        self.t_max = int(module.T_MAX)
+        self.prompt_buckets = resolve_prompt_buckets(prompt_buckets,
+                                                     self.t_max)
+        self.max_prompt = self.prompt_buckets[-1]
+        # Protocol alias: healthz/fleet surfaces that report a
+        # classifier engine's batch buckets report prompt buckets here.
+        self.buckets = self.prompt_buckets
+        self.compile_bound = 2 * len(self.prompt_buckets) + 1
+        self.trace_count = 0  # analysis: shared-under(_stats_lock)
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self.registry = (registry if registry is not None
+                         else MetricsRegistry())
+        labels = dict(metric_labels or {})
+        labelnames = tuple(sorted(labels))
+        self._c_prefills = self.registry.counter(
+            "ddp_kvcache_prefills_total",
+            "Prompt prefills executed, by padded prompt bucket",
+            labelnames + ("bucket",))
+        self._prefill_children = {
+            b: self._c_prefills.labels(bucket=str(b), **labels)
+            for b in self.prompt_buckets}
+        self._c_decode_steps = self.registry.counter(
+            "ddp_kvcache_decode_steps_total",
+            "Decode steps executed (all slots advance together)",
+            labelnames).labels(**labels)
+        self._g_active = self.registry.gauge(
+            "ddp_kvcache_active_slots",
+            "KV-cache slots currently bound to live streams",
+            labelnames).labels(**labels)
+        self._g_slots = self.registry.gauge(
+            "ddp_kvcache_slots", "Total KV-cache slots",
+            labelnames).labels(**labels)
+        self._g_slots.set(self.slots)
+        self._g_compiled = self.registry.gauge(
+            "ddp_engine_compiled_executables",
+            "Executables compiled so far (the compile-bound contract)",
+            labelnames).labels(**labels)
+
+        def _on_trace() -> None:
+            with self._stats_lock:
+                self.trace_count += 1
+            self._g_compiled.inc()
+
+        self._prefill = make_lm_prefill(module, mesh,
+                                        compute_dtype=compute_dtype,
+                                        plan=plan, on_trace=_on_trace)
+        self._write = make_cache_write(mesh, plan, on_trace=_on_trace)
+        self._decode = make_lm_decode(module, mesh,
+                                      compute_dtype=compute_dtype,
+                                      plan=plan, on_trace=_on_trace)
+
+        rep = replicated_sharding(mesh)
+        if plan is None:
+            self._params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, params), rep)
+        else:
+            # Per-leaf plan shardings (the checkpoint is canonical).
+            self._params = jax.device_put(
+                jax.tree_util.tree_map(jnp.asarray, params),
+                jax.tree_util.tree_map(
+                    lambda s: NamedSharding(mesh, s), plan.param_specs))
+
+        cache_spec, _ = _cache_specs(mesh, plan)
+        cd = compute_dtype or jnp.float32
+        shape = (int(module.N_LAYERS), slots, self.t_max,
+                 int(module.N_HEADS), int(module.HEAD_DIM))
+        csh = NamedSharding(mesh, cache_spec)
+        self._k = jax.device_put(jnp.zeros(shape, cd), csh)
+        self._v = jax.device_put(jnp.zeros(shape, cd), csh)
+
+        self._lock = threading.Lock()        # the pipeline
+        self._stats_lock = threading.Lock()  # counters (probe-readable)
+        self._free = list(range(slots))
+        self._pos: Dict[int, int] = {}       # slot -> next write position
+        self.prefills = 0       # analysis: shared-under(_stats_lock)
+        self.decode_steps = 0   # analysis: shared-under(_stats_lock)
+        self.tokens_out = 0     # analysis: shared-under(_stats_lock)
+        self.warmed = False     # analysis: shared-under(_stats_lock)
+        self.checkpoint_file: Optional[str] = None
+        self.checkpoint_epoch: Optional[int] = None
+        self.checkpoint_step: Optional[int] = None
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_checkpoint(cls, snapshot_path: str, model_name: str, *, mesh,
+                        slots: int = 8, prompt_buckets=(16, 64),
+                        compute_dtype=None, plan=None, tracer=None,
+                        registry=None,
+                        metric_labels=None) -> "KVCacheEngine":
+        """Load the newest verifiable checkpoint — the SAME lineage walk
+        as the classifier engine (any-mesh snapshot onto this serving
+        mesh)."""
+        import functools
+
+        from ..models import transformer as tfm
+        from ..resilience.lineage import latest_verifiable
+        from ..train.checkpoint import CheckpointError
+        from ..train.ckpt_shard import load_for_mesh
+        if model_name != tfm.LM_NAME:
+            raise ValueError(
+                f"generative serving supports the {tfm.LM_NAME!r} decoder "
+                f"(models/transformer.py), got {model_name!r}")
+        loaded = latest_verifiable(
+            snapshot_path,
+            loader=functools.partial(load_for_mesh, mesh=mesh))
+        if loaded is None:
+            raise CheckpointError(
+                f"no checkpoint found under {snapshot_path!r}; train the "
+                "LM first (python -m ddp_tpu.train.lm --snapshot_path)")
+        ckpt, used = loaded
+        eng = cls(tfm, ckpt.params, mesh, slots=slots,
+                  prompt_buckets=prompt_buckets,
+                  compute_dtype=compute_dtype, plan=plan, tracer=tracer,
+                  registry=registry, metric_labels=metric_labels)
+        eng.checkpoint_file = used
+        eng.checkpoint_epoch = int(ckpt.epoch)
+        eng.checkpoint_step = int(ckpt.step)
+        return eng
+
+    def warm(self) -> int:
+        """Compile every executable NOW: prefill + cache-write per prompt
+        bucket, the one decode program.  Returns ``trace_count`` (==
+        ``compile_bound`` when nothing was warm)."""
+        with self._lock:
+            for b in self.prompt_buckets:
+                zeros = jnp.zeros((b,), jnp.int32)
+                logits, k, v = self._prefill(self._params, zeros)
+                jax.block_until_ready(logits)
+                self._k, self._v = self._write(
+                    self._k, self._v, k, v, jnp.asarray(0, jnp.int32))
+            logits, self._k, self._v = self._decode(
+                self._params, jnp.zeros((self.slots,), jnp.int32),
+                jnp.zeros((self.slots,), jnp.int32), self._k, self._v)
+            jax.block_until_ready(logits)
+        with self._stats_lock:
+            self.warmed = True
+            return self.trace_count
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def free_slots(self) -> int:
+        with self._stats_lock:
+            return len(self._free)
+
+    def active_slots(self) -> int:
+        with self._stats_lock:
+            return self.slots - len(self._free)
+
+    def bucket_for(self, n_tokens: int) -> int:
+        for b in self.prompt_buckets:
+            if n_tokens <= b:
+                return b
+        raise RequestTooLarge(
+            f"{n_tokens} prompt tokens exceed the largest prompt bucket "
+            f"{self.max_prompt}; shorten the prompt or restart with a "
+            "larger --prefill_buckets set")
+
+    def start_stream(self, prompt: Sequence[int]) -> Tuple[int, int]:
+        """Admit one stream: allocate a slot, prefill its prompt into the
+        slot's cache, return ``(slot, first generated token)`` — the TTFT
+        boundary.  :class:`SlotsExhausted` when no slot is free,
+        :class:`RequestTooLarge` past the largest prompt bucket."""
+        prompt = np.asarray(prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError(f"prompt must be a non-empty 1-D token list, "
+                             f"got shape {prompt.shape}")
+        if np.any(prompt < 0) or np.any(prompt >= int(self.module.VOCAB)):
+            raise ValueError(
+                f"prompt tokens must be in [0, {int(self.module.VOCAB)})")
+        n = int(prompt.size)
+        bucket = self.bucket_for(n)
+        with self._stats_lock:
+            if not self._free:
+                raise SlotsExhausted(
+                    f"all {self.slots} KV-cache slots are occupied")
+            slot = self._free.pop(0)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = prompt
+        with self._lock:
+            logits, k, v = self._prefill(self._params, jnp.asarray(padded))
+            self._k, self._v = self._write(
+                self._k, self._v, k, v, jnp.asarray(slot, jnp.int32))
+            first = int(np.argmax(np.asarray(
+                jax.device_get(logits[n - 1]))))
+        with self._stats_lock:
+            self._pos[slot] = n
+            self.prefills += 1
+            self.tokens_out += 1
+        self._prefill_children[bucket].inc()
+        self._g_active.set(self.active_slots())
+        return slot, first
+
+    def release(self, slot: int) -> None:
+        """Return a finished/abandoned stream's slot to the free pool.
+        No cache scrub is needed: a future prefill overwrites from
+        position 0 and nothing past a stream's position is ever read."""
+        with self._stats_lock:
+            if slot in self._pos:
+                del self._pos[slot]
+                self._free.append(slot)
+        self._g_active.set(self.active_slots())
+
+    def position(self, slot: int) -> int:
+        with self._stats_lock:
+            return self._pos[slot]
+
+    # -- decoding ----------------------------------------------------------
+
+    def decode(self, last_tokens: Dict[int, int]) -> Dict[int, int]:
+        """One decode step for the given ``{slot: last token}`` streams;
+        every OTHER slot rides along computing garbage that is never read
+        (the fixed-shape contract).  Returns ``{slot: next token}`` and
+        advances each stream's position."""
+        if not last_tokens:
+            return {}
+        tokens = np.zeros((self.slots,), np.int32)
+        positions = np.zeros((self.slots,), np.int32)
+        with self._stats_lock:
+            for slot, tok in last_tokens.items():
+                pos = self._pos[slot]
+                if pos >= self.t_max:
+                    raise ServeError(
+                        f"slot {slot} is at T_MAX={self.t_max}; the "
+                        "batcher must finish streams before the cache "
+                        "runs out of positions")
+                tokens[slot] = tok
+                positions[slot] = pos
+        with self._lock:
+            logits, self._k, self._v = self._decode(
+                self._params, jnp.asarray(tokens), jnp.asarray(positions),
+                self._k, self._v)
+            out = np.asarray(jax.device_get(logits))
+        nxt = {slot: int(np.argmax(out[slot])) for slot in last_tokens}
+        with self._stats_lock:
+            for slot in last_tokens:
+                self._pos[slot] += 1
+            self.decode_steps += 1
+            self.tokens_out += len(nxt)
+        self._c_decode_steps.inc()
+        return nxt
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            return {
+                "slots": self.slots,
+                "active_slots": self.slots - len(self._free),
+                "prompt_buckets": list(self.prompt_buckets),
+                "compiled_executables": self.trace_count,
+                "compile_bound": self.compile_bound,
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "tokens_out": self.tokens_out,
+                "t_max": self.t_max,
+                "mesh_devices": int(self.mesh.devices.size),
+                "compute_dtype": (str(np.dtype(self.compute_dtype).name)
+                                  if self.compute_dtype is not None
+                                  else "float32"),
+                "checkpoint": {
+                    "file": self.checkpoint_file,
+                    "epoch": self.checkpoint_epoch,
+                    "step": self.checkpoint_step,
+                },
+            }

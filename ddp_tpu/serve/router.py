@@ -285,6 +285,18 @@ class Router:
         self._c_shed_no_replicas = shed.labels(reason="no_replicas")
         self._c_shed_overloaded = shed.labels(reason="overloaded")
         self._c_shed_draining = shed.labels(reason="draining")
+        self._c_migrations = self.registry.counter(
+            "ddp_router_session_migrations_total",
+            "Sticky generative sessions re-pinned to a different replica "
+            "(KV cache recomputed by full-history prefill)").labels()
+        # session id -> replica id, insertion-ordered for LRU eviction.
+        # analysis: shared-under(_lock)
+        self._sessions: Dict[str, str] = {}
+        self._max_sessions = 4096
+        self.registry.gauge(
+            "ddp_router_sessions",
+            "Sticky generative sessions currently pinned").labels(
+        ).set_function(lambda: float(len(self._sessions)))
         breaker_g = self.registry.gauge(
             "ddp_router_breaker_state",
             "Per-replica circuit state (0 closed, 1 half-open, 2 open)",
@@ -352,6 +364,63 @@ class Router:
 
         Mints the request id at admission; every span this request emits
         (here and downstream in the batcher) carries it."""
+        out, _ = self._route(
+            lambda st, remaining, req: st.replica.submit(
+                images, timeout=remaining, req=req), timeout)
+        return out
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 session: Optional[str] = None):
+        """Route one generative stream — same deadline/retry/shed
+        machinery as :meth:`submit`, plus STICKY sessions: a ``session``
+        id pins to the replica that served it last, so a multi-turn
+        conversation keeps hitting the replica holding its KV-cache
+        slots warm.  The pin is a PREFERENCE, not a guarantee: when the
+        pinned replica is ejected, breaker-open, full, or fails
+        mid-stream, the request re-routes like any other and the session
+        re-pins to whichever replica served it — a MIGRATION (counted,
+        logged).  Correctness never depends on the pin because every
+        request carries its full token history and a migrated stream
+        simply re-prefills on the new replica (recompute-on-migrate;
+        the mid-stream replica-crash chaos drill pins zero failed
+        streams on exactly this path)."""
+        prefer = None
+        if session is not None:
+            with self._lock:
+                prefer = self._sessions.get(session)
+
+        def send(st, remaining, req):
+            return st.replica.generate(
+                prompt, max_new_tokens=max_new_tokens, timeout=remaining,
+                req=req, session=session)
+
+        out, rid = self._route(send, timeout, prefer=prefer)
+        if session is not None:
+            with self._lock:
+                prev = self._sessions.pop(session, None)
+                self._sessions[session] = rid  # re-insert: LRU order
+                if len(self._sessions) > self._max_sessions:
+                    self._sessions.pop(next(iter(self._sessions)))
+            if prev is not None and prev != rid:
+                self._c_migrations.inc()
+                _log(f"router: session {session!r} migrated {prev} -> "
+                     f"{rid} (KV cache recomputed by full-history "
+                     "prefill)")
+        return out
+
+    def session_replica(self, session: str) -> Optional[str]:
+        """The replica id ``session`` is currently pinned to (None when
+        unknown) — the /stats sticky-routing assertion surface."""
+        with self._lock:
+            return self._sessions.get(session)
+
+    def _route(self, send, timeout: Optional[float],
+               prefer: Optional[str] = None):
+        """The shared routing loop: returns ``(result, replica_id)``.
+        ``send(state, remaining_s, req_id)`` performs one attempt on one
+        replica; ``prefer`` (a replica id) is tried first when healthy
+        and claimable — the sticky-session hint."""
         deadline = time.monotonic() + (self.default_timeout_s
                                        if timeout is None else
                                        max(float(timeout), 0.0))
@@ -364,14 +433,24 @@ class Router:
         drained: set = set()    # replicas that answered Draining TWICE
         drain_hits: Dict[str, int] = {}
         last_err: Optional[BaseException] = None
+        tried_prefer = prefer is None
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
                     f"deadline budget exhausted after {failures} "
                     f"failure(s); last error: {last_err!r}")
-            st, seq = self._pick(exclude=full | failed_on | drained,
-                                 req=req)
+            st = seq = None
+            if not tried_prefer:
+                # Sticky hint: ONE shot at the pinned replica, claimed
+                # through the same breaker gate as any pick; every
+                # subsequent round falls through to normal routing.
+                tried_prefer = True
+                st, seq = self._pick_preferred(
+                    prefer, exclude=full | failed_on | drained, req=req)
+            if st is None:
+                st, seq = self._pick(exclude=full | failed_on | drained,
+                                     req=req)
             if st is None and failed_on:
                 # Every untried replica is out; retrying the one that
                 # already failed this request beats shedding it (a
@@ -405,8 +484,7 @@ class Router:
                     "retry after the next re-admission probe",
                     self._readmit_retry_after())
             try:
-                out = st.replica.submit(images, timeout=remaining,
-                                        req=req)
+                out = send(st, remaining, req)
             except (ValueError, TypeError, RequestTooLarge):
                 # The CLIENT's error: no retry, no breaker hit — but a
                 # granted half-open probe slot must not stay latched.
@@ -478,7 +556,25 @@ class Router:
                 self._served_t.append(time.monotonic())
                 if len(self._served_t) > 512:
                     del self._served_t[:256]
-            return out
+            return out, st.replica.replica_id
+
+    def _pick_preferred(self, rid: str, exclude: set,
+                        req: Optional[str] = None
+                        ) -> Tuple[Optional["_ReplicaState"], Optional[int]]:
+        """The sticky-session pick: the pinned replica or nothing.  Same
+        gates as :meth:`_pick` — ejection, per-request exclusion, and the
+        breaker's ``allow()`` claim — so a pin can never resurrect a
+        replica routing would refuse."""
+        with self.tracer.span("route", overlap=True, req=req):
+            self._c_routed.inc()
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+                st = self._states.get(rid)
+            if (st is not None and not st.ejected and rid not in exclude
+                    and st.breaker.allow()):
+                return st, seq
+            return None, seq
 
     def _pick(self, exclude: set, req: Optional[str] = None
               ) -> Tuple[Optional[_ReplicaState], Optional[int]]:
@@ -658,6 +754,8 @@ class Router:
                 "shed_no_replicas": self.shed_no_replicas,
                 "shed_overloaded": self.shed_overloaded,
                 "shed_draining": self.shed_draining,
+                "sessions": dict(self._sessions),
+                "session_migrations": int(self._c_migrations.value),
             }
             per = [(st, st.ejected, st.served, st.failed, st.ejections)
                    for st in (self._states[rid] for rid in self._order)]

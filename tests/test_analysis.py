@@ -101,9 +101,23 @@ def head_audit():
 
 
 def test_head_registry_complete(head_audit):
-    _, audited = head_audit
-    # The model supports TP, so every registry entry must have built.
-    assert sorted(audited) == sorted(program_names())
+    ctx, audited = head_audit
+    # The model supports TP, so every registry entry FOR ITS WORKLOAD
+    # must have built; the lm_* set (round 20) builds only for tinylm.
+    assert sorted(audited) == sorted(program_names(ctx.workload))
+    lm_only = set(program_names()) - set(program_names("image"))
+    assert lm_only == {f"lm_{p}@{r}"
+                       for p in ("train_step", "prefill", "decode",
+                                 "cache_write")
+                       for r in ("dp8", "tp")}
+    assert not lm_only & set(audited)
+    # The tinylm context gets the lm set plus the workload-agnostic
+    # programs (drift_audit), and none of the image-only families.
+    lm_names = set(program_names("lm"))
+    assert lm_only <= lm_names
+    assert "drift_audit@dp8" in lm_names
+    assert not any(n.startswith(("train_step@", "serve_forward@"))
+                   for n in lm_names)
 
 
 def test_head_registry_audits_clean(head_audit):

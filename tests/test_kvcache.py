@@ -1,0 +1,321 @@
+"""KV-cache decode serving (serve/kvcache.py + token_batcher.py + the
+generative fleet path): the decode-correctness satellite of ISSUE 20.
+
+The load-bearing pin: KV-cached incremental decode produces logits
+IDENTICAL to an uncached full forward at every step — across prompt
+bucket shapes, and for a TP-trained checkpoint served on a plain 1-D
+mesh.  Everything else (slot lifecycle, compile bound, token-level
+continuous batching, sticky sessions surviving a replica crash) rides
+on that identity.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_tpu.models import transformer as tfm
+from ddp_tpu.parallel.mesh import make_mesh
+from ddp_tpu.serve.kvcache import (KVCacheEngine, SlotsExhausted,
+                                   make_cache_write, make_lm_decode,
+                                   make_lm_prefill)
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    params, _ = tfm.lm_init(jax.random.PRNGKey(7))
+    return params
+
+
+@pytest.fixture(scope="module")
+def lm_ckpt(tmp_path_factory):
+    """A tinylm checkpoint TRAINED UNDER TENSOR PARALLELISM on a (2,4)
+    data x model mesh — the artifact the TP->serve-mesh tests load."""
+    from ddp_tpu.parallel.tp.plan import plan_for_model
+    from ddp_tpu.train.lm import train_lm
+
+    mesh = make_mesh(shape=(2, 4))
+    params, _ = tfm.lm_init(jax.random.PRNGKey(0))
+    plan = plan_for_model(tfm.LM_NAME, params, model_size=4)
+    path = str(tmp_path_factory.mktemp("lmck") / "ckpt.npz")
+    train_lm(steps=3, batch=8, seq_len=16, mesh=mesh, plan=plan,
+             snapshot_path=path, quiet=True)
+    return path
+
+
+def _uncached_row(params, hist):
+    """fp32 logits for the LAST position of an uncached full forward."""
+    logits, _ = tfm.lm_apply(params, {},
+                             jnp.asarray([hist], jnp.int32), train=False)
+    return np.asarray(jax.device_get(logits[0, len(hist) - 1]))
+
+
+def _greedy_reference(params, prompt, steps):
+    """Greedy continuation computed ONLY with uncached full forwards."""
+    hist = list(prompt)
+    out = []
+    for _ in range(steps):
+        out.append(int(np.argmax(_uncached_row(params, hist))))
+        hist.append(out[-1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the identity itself: cached logits == uncached logits, every step
+
+
+def test_decode_logits_identical_to_full_forward_every_step(lm_params):
+    """Functional-layer parity: prefill logits match the uncached
+    forward row-for-row, and each incremental decode step's logits
+    match a from-scratch forward of the full history — byte-exact
+    argmax, allclose values — for 8 consecutive steps."""
+    mesh = make_mesh(1)
+    prefill = make_lm_prefill(tfm, mesh)
+    decode = make_lm_decode(tfm, mesh)
+    write = make_cache_write(mesh, None)
+
+    prompt = [5, 250, 17, 3, 99]
+    n, bucket = len(prompt), 8
+    padded = np.zeros((bucket,), np.int32)
+    padded[:n] = prompt
+    logits, k, v = prefill(lm_params, jnp.asarray(padded))
+    ref_full, _ = tfm.lm_apply(lm_params, {},
+                               jnp.asarray([prompt], jnp.int32),
+                               train=False)
+    np.testing.assert_allclose(np.asarray(logits)[:n],
+                               np.asarray(ref_full[0]),
+                               rtol=1e-5, atol=1e-5)
+
+    shape = (tfm.N_LAYERS, 1, tfm.T_MAX, tfm.N_HEADS, tfm.HEAD_DIM)
+    kc = jnp.zeros(shape, jnp.float32)
+    vc = jnp.zeros(shape, jnp.float32)
+    kc, vc = write(kc, vc, k, v, jnp.asarray(0, jnp.int32))
+
+    hist = list(prompt)
+    tok = int(np.argmax(np.asarray(logits)[n - 1]))
+    for step in range(8):
+        hist.append(tok)
+        row, kc, vc = decode(lm_params, jnp.asarray([tok], jnp.int32),
+                             jnp.asarray([len(hist) - 1], jnp.int32),
+                             kc, vc)
+        got = np.asarray(jax.device_get(row[0]))
+        want = _uncached_row(lm_params, hist)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4,
+                                   err_msg=f"decode step {step} diverged")
+        tok = int(np.argmax(got))
+
+
+@pytest.mark.parametrize("prompt_len", [3, 8, 13])
+def test_engine_greedy_tokens_match_reference_across_buckets(lm_params,
+                                                             prompt_len):
+    """Engine-level parity across bucket shapes: prompts that underfill,
+    exactly fill, and overflow the first bucket all decode the same
+    greedy continuation the uncached reference computes."""
+    mesh = make_mesh(2)
+    eng = KVCacheEngine(tfm, lm_params, mesh, slots=2,
+                        prompt_buckets=(8, 16))
+    prompt = [(i * 7 + 1) % tfm.VOCAB for i in range(prompt_len)]
+    ref = _greedy_reference(lm_params, prompt, 6)
+    slot, first = eng.start_stream(prompt)
+    got = [first]
+    while len(got) < 6:
+        got.append(eng.decode({slot: got[-1]})[slot])
+    eng.release(slot)
+    assert got == ref
+
+
+def test_concurrent_streams_do_not_cross_talk(lm_params):
+    """Two interleaved streams decode exactly what each would decode
+    alone — the slot isolation the fixed-shape decode program promises
+    (inactive lanes compute garbage that must never leak)."""
+    mesh = make_mesh(2)
+    eng = KVCacheEngine(tfm, lm_params, mesh, slots=2,
+                        prompt_buckets=(8,))
+    pa, pb = [1, 2, 3, 4], [9, 8, 7]
+    ra = _greedy_reference(lm_params, pa, 5)
+    rb = _greedy_reference(lm_params, pb, 5)
+    sa, ta = eng.start_stream(pa)
+    sb, tb = eng.start_stream(pb)
+    ga, gb = [ta], [tb]
+    while len(ga) < 5:
+        nxt = eng.decode({sa: ga[-1], sb: gb[-1]})
+        ga.append(nxt[sa])
+        gb.append(nxt[sb])
+    assert ga == ra and gb == rb
+
+
+def test_tp_trained_checkpoint_serves_on_1d_and_tp_meshes(lm_ckpt):
+    """The mesh-portability pin: a checkpoint trained under (2,4)
+    data x model TP loads onto a plain 1-D serve mesh AND onto a TP
+    serve mesh (with the serving plan re-sharding attention heads), and
+    both decode the SAME tokens."""
+    import functools
+
+    from ddp_tpu.parallel.tp.plan import plan_for_model
+    from ddp_tpu.resilience.lineage import latest_verifiable
+    from ddp_tpu.train.ckpt_shard import load_for_mesh
+
+    def run(mesh, plan_size):
+        plan = None
+        if plan_size > 1:
+            ckpt, _ = latest_verifiable(
+                lm_ckpt,
+                loader=functools.partial(load_for_mesh, mesh=mesh))
+            plan = plan_for_model(tfm.LM_NAME, ckpt.params,
+                                  model_size=plan_size)
+        eng = KVCacheEngine.from_checkpoint(
+            lm_ckpt, tfm.LM_NAME, mesh=mesh, slots=2,
+            prompt_buckets=(8,), plan=plan)
+        slot, tok = eng.start_stream([1, 2, 3, 4])
+        toks = [tok]
+        while len(toks) < 5:
+            toks.append(eng.decode({slot: toks[-1]})[slot])
+        eng.release(slot)
+        assert eng.checkpoint_file is not None
+        return toks
+
+    assert run(make_mesh(2), 1) == run(make_mesh(shape=(2, 4)), 4)
+
+
+# ---------------------------------------------------------------------------
+# slot lifecycle + compile bound
+
+
+def test_slot_exhaustion_and_release(lm_params):
+    mesh = make_mesh(2)
+    eng = KVCacheEngine(tfm, lm_params, mesh, slots=2,
+                        prompt_buckets=(8,))
+    s0, _ = eng.start_stream([1, 2])
+    s1, _ = eng.start_stream([3, 4])
+    with pytest.raises(SlotsExhausted):
+        eng.start_stream([5, 6])
+    eng.release(s0)
+    s2, _ = eng.start_stream([5, 6])
+    assert s2 == s0  # freed slot returns to the pool
+    eng.release(s1)
+    eng.release(s2)
+    assert eng.active_slots() == 0
+
+
+def test_warm_hits_the_compile_bound_and_streams_stay_free(lm_params):
+    """2 * len(prompt_buckets) + 1 executables, all compiled at warm();
+    serving afterwards never traces again (the classifier engine's
+    compile-bound contract, extended to the generative program set)."""
+    mesh = make_mesh(2)
+    eng = KVCacheEngine(tfm, lm_params, mesh, slots=2,
+                        prompt_buckets=(8, 16))
+    assert eng.compile_bound == 5
+    assert eng.warm() == 5
+    before = eng.trace_count
+    slot, tok = eng.start_stream([1, 2, 3])       # bucket 8
+    eng.decode({slot: tok})
+    eng.release(slot)
+    slot, tok = eng.start_stream(list(range(1, 13)))  # bucket 16
+    eng.decode({slot: tok})
+    eng.release(slot)
+    assert eng.trace_count == before
+
+
+# ---------------------------------------------------------------------------
+# token-level continuous batching
+
+
+def test_token_batcher_completes_concurrent_streams(lm_params):
+    """More concurrent callers than KV slots: the batcher admits as
+    slots free up and every caller gets its full greedy continuation —
+    continuous batching at token granularity, no head-of-line batch."""
+    from ddp_tpu.serve.token_batcher import TokenBatcher
+
+    mesh = make_mesh(2)
+    eng = KVCacheEngine(tfm, lm_params, mesh, slots=2,
+                        prompt_buckets=(8,))
+    eng.warm()
+    batcher = TokenBatcher(eng, max_new_tokens=4).start()
+    try:
+        prompts = [[1 + i, 2 + i, 3 + i] for i in range(5)]
+        refs = [_greedy_reference(lm_params, p, 4) for p in prompts]
+        outs = [None] * len(prompts)
+        errs = []
+
+        def worker(i):
+            try:
+                outs[i] = batcher.generate(prompts[i], timeout=60)
+            except Exception as e:  # surfaced below, not swallowed
+                errs.append((i, e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        assert not errs, errs
+        for i, out in enumerate(outs):
+            assert out["tokens"] == refs[i]
+            assert out["prompt_len"] == 3
+            assert out["ttft_ms"] >= 0.0
+        st = batcher.stats()
+        assert st["completed_streams"] == len(prompts)
+        assert st["tokens_generated"] == 4 * len(prompts)
+    finally:
+        batcher.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# sticky sessions: pin, crash, migrate, recompute
+
+
+def test_sticky_session_survives_replica_crash(lm_ckpt):
+    """The serving-fleet tentpole pin: a session sticks to one replica
+    (its KV locality), and crashing that replica mid-conversation
+    migrates the session — counted, re-pinned, and token-identical
+    because the client's full history re-prefills on the new replica."""
+    from ddp_tpu.serve.fleet import ServeFleet
+
+    mesh = make_mesh(2)
+    fleet = ServeFleet(lm_ckpt, tfm.LM_NAME, mesh=mesh, n_replicas=2,
+                       generate=True, slots=2, prompt_buckets=(8, 16),
+                       max_new_tokens=4,
+                       router_kwargs={"health_interval_s": 0.1,
+                                      "eject_after": 2})
+    fleet.start(poll_s=0)
+    try:
+        hist = [1, 2, 3, 4]
+        out = fleet.generate(hist, max_new_tokens=4, timeout=60,
+                             session="conv")
+        hist += out["tokens"]
+        pinned = fleet.router.session_replica("conv")
+        assert pinned is not None
+        # Second turn sticks.
+        out = fleet.generate(hist, max_new_tokens=4, timeout=60,
+                             session="conv")
+        hist += out["tokens"]
+        assert fleet.router.session_replica("conv") == pinned
+        assert fleet.router.stats()["session_migrations"] == 0
+        # Crash the pinned replica mid-conversation.
+        victim = next(r for r in fleet.replicas
+                      if r.replica_id == pinned)
+        victim.crashed = True
+        out = fleet.generate(hist, max_new_tokens=4, timeout=60,
+                             session="conv")
+        hist += out["tokens"]
+        moved = fleet.router.session_replica("conv")
+        assert moved is not None and moved != pinned
+        assert fleet.router.stats()["session_migrations"] == 1
+        # The migrated conversation is the SAME conversation: replay it
+        # on a fresh single engine and require identical history.
+        eng = KVCacheEngine.from_checkpoint(lm_ckpt, tfm.LM_NAME,
+                                            mesh=mesh, slots=2,
+                                            prompt_buckets=(8, 16))
+        ref = [1, 2, 3, 4]
+        for _turn in range(3):
+            slot, tok = eng.start_stream(ref)
+            toks = [tok]
+            while len(toks) < 4:
+                toks.append(eng.decode({slot: toks[-1]})[slot])
+            eng.release(slot)
+            ref += toks
+        assert hist == ref
+    finally:
+        fleet.close(timeout=20)

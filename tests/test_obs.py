@@ -3,6 +3,7 @@ live stats, straggler aggregation, and the CLI/e2e wiring — plus the
 profiling edge cases the round-7 satellites name (attribute_streaming
 clamping, categorize on full-definition-line op names)."""
 import json
+import os
 import re
 import threading
 import time
@@ -465,6 +466,48 @@ def test_cli_default_run_spills_and_reports(tmp_path, capsys, monkeypatch):
     assert 50.0 <= float(m.group(2)) <= 120.0
     n = export.validate_trace_events(json.load(open("trace.json")))
     assert n > 0
+
+
+def test_default_spill_path_anchors_on_snapshot_dir():
+    """The unset-default resolver: spills land next to the checkpoint
+    head; a bare head (CWD run) keeps the bare name; explicit paths are
+    the caller's problem and never pass through here."""
+    from ddp_tpu.obs.tracer import default_spill_path
+
+    assert default_spill_path("run/ckpt.pt", "trace_spill.jsonl") == \
+        os.path.join("run", "trace_spill.jsonl")
+    assert default_spill_path("/a/b/ckpt.pt", "serve_spill.jsonl") == \
+        "/a/b/serve_spill.jsonl"
+    assert default_spill_path("checkpoint.pt", "trace_spill.jsonl") == \
+        "trace_spill.jsonl"
+
+
+def test_default_spill_lands_in_run_dir_not_cwd(tmp_path, capsys,
+                                                monkeypatch):
+    """Regression pin (a repo-root trace_spill.jsonl once got committed):
+    a run with --snapshot_path pointing into a run directory and NO
+    --trace_spill flag must spill there, not into whatever directory the
+    CLI launched from."""
+    from ddp_tpu import cli
+
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    cwd = tmp_path / "cwd"
+    cwd.mkdir()
+    monkeypatch.chdir(cwd)
+    args = cli.build_parser("t").parse_args(
+        ["1", "1", "--batch_size", "8", "--synthetic", "--model",
+         "deepnn", "--num_devices", "2", "--synthetic_size", "32",
+         "--metrics_path", str(run_dir / "m.jsonl"),
+         "--snapshot_path", str(run_dir / "ckpt.pt")])
+    cli.run(args, num_devices=None)
+    capsys.readouterr()
+    assert (run_dir / "trace_spill.jsonl").exists()
+    assert not (cwd / "trace_spill.jsonl").exists()
+    # The serve CLI resolves its default the same way (unset default is
+    # None → anchored on the snapshot dir at runtime).
+    from ddp_tpu.serve.__main__ import build_parser as serve_parser
+    assert serve_parser().parse_args([]).trace_spill is None
 
 
 def test_cli_obs_off_emits_nothing(tmp_path, capsys, monkeypatch):

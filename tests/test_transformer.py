@@ -1,0 +1,130 @@
+"""Transformer workload (models/transformer.py): the CIFAR encoder and
+the tiny decoder-only LM, plus the attention TP recipe arithmetic.
+
+What is pinned here:
+
+- SHAPES: encoder [B,32,32,3] -> [B,10]; LM [B,T] -> [B,T,VOCAB] with
+  the T_MAX bound enforced.
+- RECIPE: the shared TP_RECIPE resolves against BOTH live param trees,
+  and the per-layer unit table (expected_collectives_by_layer) sums to
+  exactly the aggregate expected_collectives counts — the arithmetic
+  the jaxpr auditor prices strict runs with.
+- PREFILL PARITY: lm_prefill's logits equal lm_apply's (the cached and
+  uncached forwards are the same function; the KV tensors it returns
+  feed tests/test_kvcache.py's decode parity).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddp_tpu.models import transformer as tfm
+from ddp_tpu.parallel.tp.plan import (expected_collectives,
+                                      expected_collectives_by_layer,
+                                      format_collective_table,
+                                      plan_for_model)
+
+
+@pytest.fixture(scope="module")
+def enc_params():
+    return tfm.init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def lm_params():
+    params, _ = tfm.lm_init(jax.random.PRNGKey(7))
+    return params
+
+
+def test_encoder_forward_shapes(enc_params):
+    params, stats = enc_params
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    logits, _ = tfm.apply(params, stats, x, train=False)
+    assert logits.shape == (4, tfm.NUM_CLASSES)
+    assert logits.dtype == jnp.float32
+
+
+def test_lm_forward_shapes_and_t_max_bound(lm_params):
+    toks = jnp.zeros((2, 16), jnp.int32)
+    logits, _ = tfm.lm_apply(lm_params, {}, toks, train=False)
+    assert logits.shape == (2, 16, tfm.VOCAB)
+    with pytest.raises(ValueError, match="T_MAX"):
+        tfm.lm_apply(lm_params, {},
+                     jnp.zeros((1, tfm.T_MAX + 1), jnp.int32), train=False)
+
+
+def test_lm_forward_is_causal(lm_params):
+    """Perturbing a suffix token must not move any prefix logit row —
+    the property the KV cache exists to exploit."""
+    a = np.arange(1, 13, dtype=np.int32)[None, :]
+    b = a.copy()
+    b[0, -1] = 200
+    la, _ = tfm.lm_apply(lm_params, {}, jnp.asarray(a), train=False)
+    lb, _ = tfm.lm_apply(lm_params, {}, jnp.asarray(b), train=False)
+    np.testing.assert_array_equal(np.asarray(la[0, :-1]),
+                                  np.asarray(lb[0, :-1]))
+    assert not np.array_equal(np.asarray(la[0, -1]), np.asarray(lb[0, -1]))
+
+
+def test_prefill_logits_equal_uncached_forward(lm_params):
+    """lm_prefill is lm_apply plus the KV tensors — same logits, and the
+    returned k/v carry the [L, T, heads, head_dim] slot-image layout."""
+    toks = jnp.asarray(np.arange(5, 21, dtype=np.int32)[None, :])
+    ref, _ = tfm.lm_apply(lm_params, {}, toks, train=False)
+    logits, k, v = tfm.lm_prefill(lm_params, toks)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    assert k.shape == (tfm.N_LAYERS, 1, 16, tfm.N_HEADS, tfm.HEAD_DIM)
+    assert v.shape == k.shape
+
+
+@pytest.mark.parametrize("model_name,params_ix",
+                         [(tfm.NAME, 0), (tfm.LM_NAME, 1)])
+def test_shared_recipe_resolves_on_both_models(enc_params, lm_params,
+                                               model_name, params_ix):
+    params = (enc_params[0], lm_params)[params_ix]
+    plan = plan_for_model(model_name, params, model_size=4)
+    # 2 blocks x (attn qkv/out + mlp fc1/fc2) = 8 recipe layers.
+    assert len(plan.layers) == 4 * tfm.N_LAYERS
+    assert plan.stem is None  # embedding input -> no stem elision
+
+
+def test_per_layer_table_sums_to_aggregate_counts(lm_params):
+    """The satellite pin: the per-layer unit table IS the aggregate —
+    row layers 1 fwd psum each, column layers 1 bwd psum each, no stem
+    elision for this model."""
+    plan = plan_for_model(tfm.LM_NAME, lm_params, model_size=4)
+    for backward in (False, True):
+        table = expected_collectives_by_layer(plan, backward=backward)
+        exp = expected_collectives(plan, backward=backward)
+        assert sum(r["fwd"] for r in table.values()) == \
+            exp["psum_model_fwd"]
+        assert sum(r["bwd"] for r in table.values()) == \
+            exp["psum_model_bwd"]
+    # The concrete arithmetic serving and training audits price:
+    # 2 row layers/block forward, 2 column layers/block backward.
+    exp = expected_collectives(plan, backward=True)
+    assert exp["psum_model_fwd"] == 2 * tfm.N_LAYERS
+    assert exp["psum_model_bwd"] == 2 * tfm.N_LAYERS
+    assert exp["psum_model"] == 4 * tfm.N_LAYERS
+
+
+def test_collective_table_names_every_layer(lm_params):
+    plan = plan_for_model(tfm.LM_NAME, lm_params, model_size=4)
+    out = format_collective_table(plan, backward=True)
+    for path, _style in plan.layers:
+        assert path in out
+    assert f"total: fwd={2 * tfm.N_LAYERS} bwd={2 * tfm.N_LAYERS}" in out
+
+
+def test_pp_blocks_cover_the_lm_param_tree(lm_params):
+    """Every PP block names a real param subtree and together they cover
+    the whole tree (the stage-partition contract)."""
+    covered = set()
+    for path in tfm.PP_BLOCKS:
+        node = lm_params
+        for part in path.split("/"):
+            assert part in node, f"PP block {path!r} misses the tree"
+            node = node[part]
+        covered.add(path.split("/")[0])
+    assert covered == set(lm_params.keys())

@@ -35,7 +35,7 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 HIGHER_BETTER = ("samples/sec", "req/s", "mfu", "fraction", "accuracy",
-                 "speedup")
+                 "speedup", "tokens/s", "tokens/sec")
 LOWER_BETTER = ("ms", "s/flop", "s/byte", "seconds", "%", "s")
 
 
